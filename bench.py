@@ -1,0 +1,90 @@
+"""Headline benchmark: simulated RD/WR instructions/sec on one chip.
+
+North star (BASELINE.json): >= 1e8 simulated instrs/sec at 4096 simulated
+cores on one TPU v5e chip, with printProcessorState byte-parity on the
+reference suites (covered by tests/). The reference publishes no
+throughput numbers (BASELINE.md), so vs_baseline is measured against the
+north-star target.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=4096)
+    ap.add_argument("--trace-len", type=int, default=96)
+    ap.add_argument("--chunk", type=int, default=64,
+                    help="cycles per timed device call")
+    ap.add_argument("--workload", default="uniform")
+    ap.add_argument("--local-frac", type=float, default=0.8)
+    ap.add_argument("--admission", type=int, default=None,
+                    help="max concurrent outstanding requests (backpressure "
+                         "window; None = reference drop semantics)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny config on CPU for smoke testing")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.smoke:
+        jax.config.update("jax_platforms", "cpu")
+
+    from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+    from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
+    from ue22cs343bb1_openmp_assignment_tpu.ops.step import run_cycles
+
+    if args.smoke:
+        args.nodes, args.trace_len, args.chunk = 64, 8, 8
+
+    cfg = SystemConfig.scale(num_nodes=args.nodes,
+                             admission_window=args.admission)
+    gen_kw = {"local_frac": args.local_frac} if args.workload == "uniform" else {}
+    sys_ = CoherenceSystem.from_workload(
+        cfg, args.workload, trace_len=args.trace_len, seed=0, **gen_kw)
+
+    # warmup: compile the chunked runner (discarded copy)
+    jax.block_until_ready(run_cycles(cfg, sys_.state, args.chunk))
+
+    # timed: run chunks until every trace is exhausted (quiescence), so
+    # the measurement covers real protocol traffic, not idle spinning.
+    state = sys_.state
+    t0 = time.perf_counter()
+    total_cycles = 0
+    while True:
+        state = run_cycles(cfg, state, args.chunk)
+        total_cycles += args.chunk
+        if bool(state.quiescent()) or total_cycles > 200 * args.trace_len:
+            break
+    jax.block_until_ready(state)
+    elapsed = time.perf_counter() - t0
+
+    retired = int(state.metrics.instrs_retired)
+    value = retired / elapsed
+    result = {
+        "metric": f"simulated RD/WR instrs/sec @{args.nodes} cores "
+                  f"({args.workload}, 1 chip, "
+                  f"{jax.devices()[0].platform})",
+        "value": round(value, 1),
+        "unit": "instrs/sec",
+        "vs_baseline": round(value / 1e8, 4),
+    }
+    extra = {
+        "cycles": int(state.metrics.cycles),
+        "retired": retired,
+        "quiescent": bool(state.quiescent()),
+        "elapsed_s": round(elapsed, 3),
+        "msgs_dropped": int(state.metrics.msgs_dropped),
+    }
+    print(json.dumps(result))
+    print(json.dumps(extra), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

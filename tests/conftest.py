@@ -1,0 +1,32 @@
+"""Test environment: force CPU with 8 virtual devices (sharding tests).
+
+Must run before the first `import jax` anywhere in the test session.
+Real-TPU behavior is exercised by bench.py / the driver, not by pytest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon TPU plugin ignores JAX_PLATFORMS; force CPU explicitly so the
+# suite is hermetic and the 8-device virtual mesh is available.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+REFERENCE_TESTS = "/root/reference/tests"
+
+
+def reference_available() -> bool:
+    return os.path.isdir(REFERENCE_TESTS)
+
+
+requires_reference = pytest.mark.skipif(
+    not reference_available(),
+    reason="reference fixture tree not mounted at /root/reference/tests")

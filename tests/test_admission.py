@@ -1,0 +1,70 @@
+"""Backpressure (admission window) semantics.
+
+The reference silently drops messages on a full mailbox
+(``assignment.c:754-762``); at its 4-node/256-slot dimensions overflow is
+unreachable, but at scale a dropped reply leaves its requester blocked
+forever — livelock (SURVEY quirk 6 calls out the latent deadlock). The
+admission window caps outstanding transactions so bounded mailboxes can
+never overflow; these tests pin both the failure mode and the fix.
+"""
+
+import numpy as np
+import pytest
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
+from ue22cs343bb1_openmp_assignment_tpu.native.bindings import NativeEngine
+from ue22cs343bb1_openmp_assignment_tpu.ops.step import run_to_quiescence
+from ue22cs343bb1_openmp_assignment_tpu.state import init_state
+
+from tests.test_native_differential import (assert_state_equal,
+                                            random_traces)
+
+
+def hot_spot_system(admission, num_nodes=32, queue_capacity=8):
+    cfg = SystemConfig.scale(num_nodes=num_nodes,
+                             queue_capacity=queue_capacity,
+                             admission_window=admission)
+    return CoherenceSystem.from_workload(cfg, "false_sharing", trace_len=8,
+                                         seed=0)
+
+
+def test_hot_spot_livelocks_without_admission():
+    """Documents the reference-semantics failure mode: overflow drops a
+    reply and the machine never quiesces."""
+    sys_ = hot_spot_system(admission=None).run(max_cycles=20_000)
+    m = sys_.metrics
+    assert m["msgs_dropped"] > 0
+    assert not sys_.quiescent  # livelocked: blocked nodes wait forever
+
+
+def test_admission_window_prevents_livelock():
+    window = 8 // 6  # Q/6 bound from config docstring
+    sys_ = hot_spot_system(admission=max(1, window)).run(max_cycles=50_000)
+    m = sys_.metrics
+    assert m["msgs_dropped"] == 0
+    assert sys_.quiescent
+    assert m["instrs_retired"] == 32 * 8  # every instruction completed
+
+
+def test_admission_differential_with_native():
+    """JAX and C++ engines must gate identically (same admitted set)."""
+    cfg = SystemConfig(num_nodes=8, cache_size=4, mem_size=16,
+                       queue_capacity=16, max_instrs=16,
+                       admission_window=2)
+    rng = np.random.RandomState(42)
+    traces = random_traces(rng, cfg, trace_len=12)
+    jx_final = run_to_quiescence(cfg, init_state(cfg, traces), 50_000)
+    assert bool(jx_final.quiescent())
+
+    nat = NativeEngine(cfg)
+    nat.load_traces(traces)
+    nat.run(50_000)
+    assert nat.quiescent
+    assert_state_equal(jx_final, nat.export_state(), "admission window 2")
+
+
+def test_parity_configs_unaffected():
+    """The reference parity config never gates (admission_window=None)."""
+    cfg = SystemConfig.reference()
+    assert cfg.admission_window is None

@@ -1,0 +1,51 @@
+"""Tiled uint32 sharer-bitvector helpers (replaces the reference's single
+byte, assignment.c:63; enables >8 nodes)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from ue22cs343bb1_openmp_assignment_tpu.state import (bit_get, bit_set,
+                                                      bit_single, ctz,
+                                                      popcount)
+
+
+def test_single_word():
+    n = jnp.array([0, 5, 31])
+    bv = bit_single(1, n)
+    assert bv.tolist() == [[1], [1 << 5], [1 << 31]]
+    assert bit_get(bv, n).tolist() == [True, True, True]
+    assert popcount(bv).tolist() == [1, 1, 1]
+    assert ctz(bv).tolist() == [0, 5, 31]
+
+
+def test_multi_word():
+    n = jnp.array([0, 32, 95, 64])
+    bv = bit_single(3, n)
+    assert bit_get(bv, n).tolist() == [True] * 4
+    assert ctz(bv).tolist() == [0, 32, 95, 64]
+    assert popcount(bv).tolist() == [1] * 4
+    # clearing returns to empty
+    cleared = bit_set(bv, n, on=False)
+    assert popcount(cleared).tolist() == [0] * 4
+    assert ctz(cleared).tolist() == [96] * 4  # sentinel = num bits
+
+
+def test_set_accumulates():
+    bv = jnp.zeros((1, 2), jnp.uint32)
+    for node in (0, 33, 63):
+        bv = bit_set(bv, jnp.array([node]))
+    assert popcount(bv).tolist() == [3]
+    assert ctz(bv).tolist() == [0]
+    assert bit_get(bv, jnp.array([33])).tolist() == [True]
+    assert bit_get(bv, jnp.array([34])).tolist() == [False]
+
+
+def test_matches_reference_byte_semantics():
+    # __builtin_ctz / __builtin_popcount on the byte vector
+    # (assignment.c:209,451,564)
+    rng = np.random.RandomState(0)
+    for _ in range(50):
+        b = int(rng.randint(1, 256))
+        bv = jnp.array([[b]], jnp.uint32)
+        assert int(popcount(bv)[0]) == bin(b).count("1")
+        assert int(ctz(bv)[0]) == (b & -b).bit_length() - 1

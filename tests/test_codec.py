@@ -1,0 +1,42 @@
+"""Address codec unit tests (reference nibble scheme, assignment.c:46-49)."""
+
+import jax.numpy as jnp
+
+from ue22cs343bb1_openmp_assignment_tpu import codec
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+
+
+def test_reference_nibbles():
+    cfg = SystemConfig.reference()
+    # 0x36 = block 6 of node 3 (assignment.c:49)
+    assert codec.home_node(cfg, 0x36) == 3
+    assert codec.block_index(cfg, 0x36) == 6
+    assert codec.cache_index(cfg, 0x36) == 6 % 4
+    assert codec.make_address(cfg, 3, 6) == 0x36
+
+
+def test_codec_vectorized():
+    cfg = SystemConfig.reference()
+    addrs = jnp.array([0x00, 0x0F, 0x15, 0x3F])
+    assert codec.home_node(cfg, addrs).tolist() == [0, 0, 1, 3]
+    assert codec.block_index(cfg, addrs).tolist() == [0, 15, 5, 15]
+    assert codec.cache_index(cfg, addrs).tolist() == [0, 3, 1, 3]
+
+
+def test_generalized_geometry():
+    cfg = SystemConfig.scale(num_nodes=256)
+    assert cfg.block_bits == 4
+    assert cfg.bitvec_words == 8
+    a = codec.make_address(cfg, 200, 9)
+    assert codec.home_node(cfg, a) == 200
+    assert codec.block_index(cfg, a) == 9
+
+
+def test_roundtrip_all_reference_addresses():
+    cfg = SystemConfig.reference()
+    for node in range(4):
+        for block in range(16):
+            a = codec.make_address(cfg, node, block)
+            assert codec.home_node(cfg, a) == node
+            assert codec.block_index(cfg, a) == block
+            assert a <= 0x3F

@@ -1,0 +1,44 @@
+"""Golden-dump formatter parity: byte-exact round-trip of every reference
+golden file through parse_dump -> format_node_dump (proves the writer
+reproduces printProcessorState, assignment.c:853-905, including the
+0x%08B binary bitvector and the ' \\t|' cache-row tail)."""
+
+import glob
+
+from tests.conftest import REFERENCE_TESTS, requires_reference
+
+from ue22cs343bb1_openmp_assignment_tpu.utils.golden import (NodeDump,
+                                                             format_node_dump,
+                                                             parse_dump)
+
+
+@requires_reference
+def test_roundtrip_every_reference_golden():
+    paths = sorted(glob.glob(f"{REFERENCE_TESTS}/**/core_*_output.txt",
+                             recursive=True))
+    assert len(paths) >= 36
+    for p in paths:
+        text = open(p).read()
+        assert format_node_dump(parse_dump(text)) == text, p
+
+
+def test_format_traps():
+    """The format traps survive synthetic state (quirk 8)."""
+    import numpy as np
+    d = NodeDump(node_id=2,
+                 memory=np.arange(16) + 40,
+                 dir_state=np.array([0, 1, 2] + [2] * 13),
+                 dir_bitvec=np.array([0b11, 0b1000, 0] + [0] * 13,
+                                     dtype=object),
+                 cache_addr=np.array([0xFF, 0x21, 0x36, 0x0B]),
+                 cache_val=np.array([0, 7, 255, 13]),
+                 cache_state=np.array([3, 1, 0, 2]))
+    out = format_node_dump(d)
+    # binary rendering behind a literal 0x prefix
+    assert "|   0x00000011   |" in out
+    assert "|   0x00001000   |" in out
+    # cache rows end in space + hard tab + pipe
+    assert "|  EXCLUSIVE \t|" in out
+    assert "|   INVALID \t|" in out
+    # home-node-prefixed addresses: node 2 block 0 -> 0x20
+    assert "|    0  |  0x20   |" in out

@@ -1,0 +1,405 @@
+"""Per-handler MESI/directory transition tests.
+
+The reference has zero unit tests (SURVEY §4) — its entire contract is
+end-state golden diffs. These tests pin each handler's transition table
+(SURVEY §2 "C8 per-handler detail") directly, including the quirky
+behaviors that golden tests only exercise incidentally.
+
+Each test stages one node's state, injects one message (or one
+instruction), runs exactly one cycle, and asserts the masked updates and
+emitted messages.
+"""
+
+import jax.numpy as jnp
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.ops.mailbox import push_message
+from ue22cs343bb1_openmp_assignment_tpu.ops.step import cycle
+from ue22cs343bb1_openmp_assignment_tpu.state import init_state
+from ue22cs343bb1_openmp_assignment_tpu.types import (CacheState, DirState,
+                                                      Msg, Op)
+
+CFG = SystemConfig.reference()
+
+
+def fresh():
+    return init_state(CFG)
+
+
+def inbox(state, node):
+    """All messages currently queued at `node` as dicts, FIFO order."""
+    out = []
+    h, c = int(state.mb_head[node]), int(state.mb_count[node])
+    for i in range(c):
+        s = (h + i) % CFG.queue_capacity
+        out.append(dict(type=Msg(int(state.mb_type[node, s])),
+                        sender=int(state.mb_sender[node, s]),
+                        addr=int(state.mb_addr[node, s]),
+                        value=int(state.mb_value[node, s]),
+                        second=int(state.mb_second[node, s]),
+                        dirstate=int(state.mb_dirstate[node, s]),
+                        bitvec=int(state.mb_bitvec[node, s, 0])))
+    return out
+
+
+def set_cache(state, node, idx, addr, value, cstate):
+    return state.replace(
+        cache_addr=state.cache_addr.at[node, idx].set(addr),
+        cache_val=state.cache_val.at[node, idx].set(value),
+        cache_state=state.cache_state.at[node, idx].set(int(cstate)))
+
+
+def set_dir(state, node, block, dstate, bitvec):
+    return state.replace(
+        dir_state=state.dir_state.at[node, block].set(int(dstate)),
+        dir_bitvec=state.dir_bitvec.at[node, block, 0].set(bitvec))
+
+
+# ---------------------------------------------------------------------------
+# READ_REQUEST at home (assignment.c:191-237)
+
+def test_read_request_unowned():
+    st = fresh()
+    st = push_message(CFG, st, 1, type=Msg.READ_REQUEST, sender=3, addr=0x15)
+    st2 = cycle(CFG, st)
+    # home replies with memory value, dirState=EM; directory U -> EM {3}
+    [msg] = inbox(st2, 3)
+    assert msg["type"] == Msg.REPLY_RD
+    assert msg["value"] == 20 * 1 + 5
+    assert msg["dirstate"] == int(DirState.EM)
+    assert int(st2.dir_state[1, 5]) == int(DirState.EM)
+    assert int(st2.dir_bitvec[1, 5, 0]) == 0b1000
+
+
+def test_read_request_shared_adds_sharer():
+    st = set_dir(fresh(), 1, 5, DirState.S, 0b0001)
+    st = push_message(CFG, st, 1, type=Msg.READ_REQUEST, sender=2, addr=0x15)
+    st2 = cycle(CFG, st)
+    [msg] = inbox(st2, 2)
+    assert msg["type"] == Msg.REPLY_RD and msg["dirstate"] == int(DirState.S)
+    assert int(st2.dir_bitvec[1, 5, 0]) == 0b0101
+    assert int(st2.dir_state[1, 5]) == int(DirState.S)
+
+
+def test_read_request_em_forwards_writeback_int_and_defers_dir():
+    """Quirk 4: dir untouched until FLUSH returns (assignment.c:199-210)."""
+    st = set_dir(fresh(), 1, 5, DirState.EM, 0b0100)  # owner = node 2
+    st = push_message(CFG, st, 1, type=Msg.READ_REQUEST, sender=0, addr=0x15)
+    st2 = cycle(CFG, st)
+    [msg] = inbox(st2, 2)
+    assert msg["type"] == Msg.WRITEBACK_INT
+    assert msg["second"] == 0 and msg["sender"] == 1
+    # directory deliberately unchanged until FLUSH
+    assert int(st2.dir_state[1, 5]) == int(DirState.EM)
+    assert int(st2.dir_bitvec[1, 5, 0]) == 0b0100
+
+
+# ---------------------------------------------------------------------------
+# REPLY_RD at requester (assignment.c:239-255)
+
+def test_reply_rd_fills_exclusive_or_shared():
+    st = fresh()
+    st = push_message(CFG, st, 2, type=Msg.REPLY_RD, sender=1, addr=0x15,
+                      value=77, dirstate=DirState.EM)
+    st2 = cycle(CFG, st)
+    assert int(st2.cache_addr[2, 1]) == 0x15
+    assert int(st2.cache_val[2, 1]) == 77
+    assert int(st2.cache_state[2, 1]) == int(CacheState.EXCLUSIVE)
+    assert not bool(st2.waiting[2])
+
+    st = push_message(CFG, fresh(), 2, type=Msg.REPLY_RD, sender=1,
+                      addr=0x15, value=9, dirstate=DirState.S)
+    st2 = cycle(CFG, st)
+    assert int(st2.cache_state[2, 1]) == int(CacheState.SHARED)
+
+
+def test_reply_rd_evicts_conflicting_line():
+    st = set_cache(fresh(), 2, 1, 0x25, 99, CacheState.MODIFIED)
+    st = push_message(CFG, st, 2, type=Msg.REPLY_RD, sender=1, addr=0x15,
+                      value=7, dirstate=DirState.EM)
+    st2 = cycle(CFG, st)
+    # dirty line 0x25 -> EVICT_MODIFIED with value to its home (node 2)
+    msgs = inbox(st2, 2)
+    assert [m["type"] for m in msgs] == [Msg.EVICT_MODIFIED]
+    assert msgs[0]["addr"] == 0x25 and msgs[0]["value"] == 99
+    assert int(st2.cache_addr[2, 1]) == 0x15
+
+
+# ---------------------------------------------------------------------------
+# WRITEBACK_INT at old owner (assignment.c:257-286)
+
+def test_writeback_int_flushes_and_demotes():
+    st = set_cache(fresh(), 2, 1, 0x15, 55, CacheState.MODIFIED)
+    st = push_message(CFG, st, 2, type=Msg.WRITEBACK_INT, sender=1,
+                      addr=0x15, second=0)
+    st2 = cycle(CFG, st)
+    assert int(st2.cache_state[2, 1]) == int(CacheState.SHARED)
+    [at_home] = inbox(st2, 1)
+    [at_req] = inbox(st2, 0)
+    for m in (at_home, at_req):
+        assert m["type"] == Msg.FLUSH and m["value"] == 55 and m["second"] == 0
+
+
+def test_writeback_int_dedups_home_eq_requester():
+    """Quirk 3 (first half): single FLUSH when home == requester
+    (assignment.c:281)."""
+    st = set_cache(fresh(), 2, 1, 0x15, 55, CacheState.EXCLUSIVE)
+    st = push_message(CFG, st, 2, type=Msg.WRITEBACK_INT, sender=1,
+                      addr=0x15, second=1)
+    st2 = cycle(CFG, st)
+    assert len(inbox(st2, 1)) == 1  # one FLUSH, not two
+
+
+# ---------------------------------------------------------------------------
+# FLUSH (assignment.c:288-323)
+
+def test_flush_at_home_updates_dir_and_memory():
+    st = set_dir(fresh(), 1, 5, DirState.EM, 0b0100)
+    st = push_message(CFG, st, 1, type=Msg.FLUSH, sender=2, addr=0x15,
+                      value=55, second=0)
+    st2 = cycle(CFG, st)
+    assert int(st2.dir_state[1, 5]) == int(DirState.S)
+    assert int(st2.dir_bitvec[1, 5, 0]) == 0b0101  # requester ORed in
+    assert int(st2.memory[1, 5]) == 55
+
+
+def test_flush_at_requester_fills_shared():
+    st = push_message(CFG, fresh(), 0, type=Msg.FLUSH, sender=2, addr=0x15,
+                      value=55, second=0)
+    st = st.replace(waiting=st.waiting.at[0].set(True))
+    st2 = cycle(CFG, st)
+    assert int(st2.cache_state[0, 1]) == int(CacheState.SHARED)
+    assert int(st2.cache_val[0, 1]) == 55
+    assert not bool(st2.waiting[0])
+
+
+def test_flush_unconditionally_unblocks_pure_home():
+    """Quirk 2: a node acting only as home still clears waitingForReply
+    (assignment.c:322)."""
+    st = fresh().replace(waiting=jnp.zeros(4, bool).at[1].set(True))
+    st = push_message(CFG, st, 1, type=Msg.FLUSH, sender=2, addr=0x15,
+                      value=1, second=0)  # node 1 is home, not requester
+    st2 = cycle(CFG, st)
+    assert not bool(st2.waiting[1])
+
+
+# ---------------------------------------------------------------------------
+# UPGRADE / REPLY_ID / INV (assignment.c:325-399)
+
+def test_upgrade_returns_other_sharers_and_takes_ownership():
+    st = set_dir(fresh(), 1, 5, DirState.S, 0b1101)
+    st = push_message(CFG, st, 1, type=Msg.UPGRADE, sender=0, addr=0x15)
+    st2 = cycle(CFG, st)
+    [msg] = inbox(st2, 0)
+    assert msg["type"] == Msg.REPLY_ID
+    assert msg["bitvec"] == 0b1100  # requester excluded (assignment.c:335)
+    assert int(st2.dir_state[1, 5]) == int(DirState.EM)
+    assert int(st2.dir_bitvec[1, 5, 0]) == 0b0001
+
+
+def test_reply_id_fans_out_inv_and_fills_from_latched_instr():
+    """Quirk 1: fill value comes from the in-flight instruction, not the
+    message (assignment.c:383)."""
+    st = fresh().replace(cur_val=jnp.zeros(4, jnp.int32).at[0].set(123),
+                         waiting=jnp.zeros(4, bool).at[0].set(True))
+    st = push_message(CFG, st, 0, type=Msg.REPLY_ID, sender=1, addr=0x15,
+                      bitvec=0b1100)
+    st2 = cycle(CFG, st)
+    for sharer in (2, 3):
+        [msg] = inbox(st2, sharer)
+        assert msg["type"] == Msg.INV and msg["addr"] == 0x15
+    assert int(st2.cache_val[0, 1]) == 123
+    assert int(st2.cache_state[0, 1]) == int(CacheState.MODIFIED)
+    assert not bool(st2.waiting[0])
+
+
+def test_inv_only_applies_on_tag_match():
+    st = set_cache(fresh(), 2, 1, 0x15, 5, CacheState.SHARED)
+    st = push_message(CFG, st, 2, type=Msg.INV, sender=0, addr=0x15)
+    st2 = cycle(CFG, st)
+    assert int(st2.cache_state[2, 1]) == int(CacheState.INVALID)
+
+    # different tag in the same slot -> untouched (assignment.c:396)
+    st = set_cache(fresh(), 2, 1, 0x25, 5, CacheState.SHARED)
+    st = push_message(CFG, st, 2, type=Msg.INV, sender=0, addr=0x15)
+    st2 = cycle(CFG, st)
+    assert int(st2.cache_state[2, 1]) == int(CacheState.SHARED)
+
+
+# ---------------------------------------------------------------------------
+# WRITE_REQUEST / REPLY_WR / WRITEBACK_INV / FLUSH_INVACK
+# (assignment.c:401-536)
+
+def test_write_request_unowned():
+    st = push_message(CFG, fresh(), 1, type=Msg.WRITE_REQUEST, sender=3,
+                      addr=0x15, value=42)
+    st2 = cycle(CFG, st)
+    [msg] = inbox(st2, 3)
+    assert msg["type"] == Msg.REPLY_WR
+    assert int(st2.dir_state[1, 5]) == int(DirState.EM)
+    assert int(st2.dir_bitvec[1, 5, 0]) == 0b1000
+
+
+def test_write_request_shared_sends_reply_id():
+    st = set_dir(fresh(), 1, 5, DirState.S, 0b0111)
+    st = push_message(CFG, st, 1, type=Msg.WRITE_REQUEST, sender=0,
+                      addr=0x15, value=42)
+    st2 = cycle(CFG, st)
+    [msg] = inbox(st2, 0)
+    assert msg["type"] == Msg.REPLY_ID and msg["bitvec"] == 0b0110
+    assert int(st2.dir_bitvec[1, 5, 0]) == 0b0001
+
+
+def test_write_request_em_sends_writeback_inv_and_updates_dir_now():
+    """Quirk 4 (second half): write path updates the directory
+    immediately and unconditionally (assignment.c:455-457)."""
+    st = set_dir(fresh(), 1, 5, DirState.EM, 0b0100)
+    st = push_message(CFG, st, 1, type=Msg.WRITE_REQUEST, sender=0,
+                      addr=0x15, value=42)
+    st2 = cycle(CFG, st)
+    [msg] = inbox(st2, 2)  # old owner
+    assert msg["type"] == Msg.WRITEBACK_INV and msg["second"] == 0
+    assert int(st2.dir_state[1, 5]) == int(DirState.EM)
+    assert int(st2.dir_bitvec[1, 5, 0]) == 0b0001  # already the requester
+
+
+def test_reply_wr_unconditional_replacement_call():
+    """REPLY_WR calls handleCacheReplacement without the tag-mismatch
+    check (assignment.c:467) — a clean E line is evicted even for the
+    same address."""
+    st = set_cache(fresh(), 3, 1, 0x15, 7, CacheState.EXCLUSIVE)
+    st = st.replace(cur_val=jnp.zeros(4, jnp.int32).at[3].set(42))
+    st = push_message(CFG, st, 3, type=Msg.REPLY_WR, sender=1, addr=0x15)
+    st2 = cycle(CFG, st)
+    [ev] = inbox(st2, 1)
+    assert ev["type"] == Msg.EVICT_SHARED and ev["addr"] == 0x15
+    assert int(st2.cache_val[3, 1]) == 42
+    assert int(st2.cache_state[3, 1]) == int(CacheState.MODIFIED)
+
+
+def test_writeback_inv_no_dedup_double_send():
+    """Quirk 3 (second half): home==requester receives FLUSH_INVACK twice
+    (assignment.c:492-498)."""
+    st = set_cache(fresh(), 2, 1, 0x15, 88, CacheState.MODIFIED)
+    st = push_message(CFG, st, 2, type=Msg.WRITEBACK_INV, sender=1,
+                      addr=0x15, second=1)  # home 1 == requester 1
+    st2 = cycle(CFG, st)
+    msgs = inbox(st2, 1)
+    assert [m["type"] for m in msgs] == [Msg.FLUSH_INVACK, Msg.FLUSH_INVACK]
+    assert all(m["value"] == 88 for m in msgs)
+    assert int(st2.cache_state[2, 1]) == int(CacheState.INVALID)
+
+
+def test_flush_invack_at_home_and_requester():
+    st = set_dir(fresh(), 1, 5, DirState.EM, 0b0001)
+    st = push_message(CFG, st, 1, type=Msg.FLUSH_INVACK, sender=2,
+                      addr=0x15, value=66, second=0)
+    st2 = cycle(CFG, st)
+    assert int(st2.dir_bitvec[1, 5, 0]) == 0b0001
+    assert int(st2.memory[1, 5]) == 66
+
+    st = fresh().replace(cur_val=jnp.zeros(4, jnp.int32).at[0].set(42),
+                         waiting=jnp.zeros(4, bool).at[0].set(True))
+    st = push_message(CFG, st, 0, type=Msg.FLUSH_INVACK, sender=2,
+                      addr=0x15, value=66, second=0)
+    st2 = cycle(CFG, st)
+    assert int(st2.cache_val[0, 1]) == 42  # latched instr value, not 66
+    assert int(st2.cache_state[0, 1]) == int(CacheState.MODIFIED)
+    assert not bool(st2.waiting[0])
+
+
+# ---------------------------------------------------------------------------
+# EVICT_SHARED / EVICT_MODIFIED (assignment.c:538-617)
+
+def test_evict_shared_last_sharer_promotion():
+    st = set_dir(fresh(), 1, 5, DirState.S, 0b0101)
+    st = push_message(CFG, st, 1, type=Msg.EVICT_SHARED, sender=0, addr=0x15)
+    st2 = cycle(CFG, st)
+    assert int(st2.dir_state[1, 5]) == int(DirState.EM)
+    assert int(st2.dir_bitvec[1, 5, 0]) == 0b0100
+    [msg] = inbox(st2, 2)  # remaining sharer told to promote S -> E
+    assert msg["type"] == Msg.EVICT_SHARED
+    # ... and the recipient blindly promotes (no tag check,
+    # assignment.c:558)
+    st3 = cycle(CFG, st2)
+    assert int(st3.cache_state[2, 1]) == int(CacheState.EXCLUSIVE)
+
+
+def test_evict_shared_home_self_promotion():
+    st = set_dir(fresh(), 1, 5, DirState.S, 0b0011)
+    st = set_cache(st, 1, 1, 0x15, 3, CacheState.SHARED)
+    st = push_message(CFG, st, 1, type=Msg.EVICT_SHARED, sender=0, addr=0x15)
+    st2 = cycle(CFG, st)
+    # home itself is the last sharer: inline promotion (assignment.c:584-587)
+    assert int(st2.cache_state[1, 1]) == int(CacheState.EXCLUSIVE)
+    assert int(st2.dir_state[1, 5]) == int(DirState.EM)
+    assert all(len(inbox(st2, n)) == 0 for n in range(4))
+
+
+def test_evict_shared_to_unowned():
+    st = set_dir(fresh(), 1, 5, DirState.EM, 0b0100)
+    st = push_message(CFG, st, 1, type=Msg.EVICT_SHARED, sender=2, addr=0x15)
+    st2 = cycle(CFG, st)
+    assert int(st2.dir_state[1, 5]) == int(DirState.U)
+    assert int(st2.dir_bitvec[1, 5, 0]) == 0
+
+
+def test_evict_modified_writes_back_and_clears():
+    st = set_dir(fresh(), 1, 5, DirState.EM, 0b0100)
+    st = push_message(CFG, st, 1, type=Msg.EVICT_MODIFIED, sender=2,
+                      addr=0x15, value=201)
+    st2 = cycle(CFG, st)
+    assert int(st2.memory[1, 5]) == 201
+    assert int(st2.dir_state[1, 5]) == int(DirState.U)
+    assert int(st2.dir_bitvec[1, 5, 0]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Instruction frontend (assignment.c:654-735)
+
+def set_instr(state, node, instrs):
+    for i, (op, addr, val) in enumerate(instrs):
+        state = state.replace(
+            instr_op=state.instr_op.at[node, i].set(int(op)),
+            instr_addr=state.instr_addr.at[node, i].set(addr),
+            instr_val=state.instr_val.at[node, i].set(val))
+    return state.replace(
+        instr_count=state.instr_count.at[node].set(len(instrs)))
+
+
+def test_read_miss_blocks_on_read_request():
+    st = set_instr(fresh(), 0, [(Op.READ, 0x15, 0)])
+    st2 = cycle(CFG, st)
+    [msg] = inbox(st2, 1)
+    assert msg["type"] == Msg.READ_REQUEST and msg["sender"] == 0
+    assert bool(st2.waiting[0])
+    assert int(st2.instr_idx[0]) == 0
+
+
+def test_write_hit_exclusive_goes_modified_locally():
+    st = set_cache(fresh(), 0, 1, 0x15, 7, CacheState.EXCLUSIVE)
+    st = set_instr(st, 0, [(Op.WRITE, 0x15, 99)])
+    st2 = cycle(CFG, st)
+    assert int(st2.cache_val[0, 1]) == 99
+    assert int(st2.cache_state[0, 1]) == int(CacheState.MODIFIED)
+    assert not bool(st2.waiting[0])
+    assert all(len(inbox(st2, n)) == 0 for n in range(4))
+
+
+def test_write_hit_shared_sends_upgrade():
+    st = set_cache(fresh(), 0, 1, 0x15, 7, CacheState.SHARED)
+    st = set_instr(st, 0, [(Op.WRITE, 0x15, 99)])
+    st2 = cycle(CFG, st)
+    [msg] = inbox(st2, 1)
+    assert msg["type"] == Msg.UPGRADE and msg["value"] == 99
+    assert bool(st2.waiting[0])
+
+
+def test_message_processing_preempts_instruction_fetch():
+    """Drain-before-fetch priority (assignment.c:165-177)."""
+    st = set_instr(fresh(), 2, [(Op.READ, 0x20, 0)])
+    st = push_message(CFG, st, 2, type=Msg.INV, sender=0, addr=0x15)
+    st2 = cycle(CFG, st)
+    assert int(st2.instr_idx[2]) == -1  # instruction not fetched yet
+    st3 = cycle(CFG, st2)
+    assert int(st3.instr_idx[2]) == 0

@@ -1,0 +1,31 @@
+"""TPU-native directory-based cache-coherence simulation framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the reference
+OpenMP simulator (``/root/reference/assignment.c``): a DASH-style 3-state
+directory over MESI caches on a distributed-shared-memory machine.
+
+Instead of the reference's thread-per-node / lock / spin architecture
+(one OpenMP thread per simulated processor, ``assignment.c:149``), the
+whole system is expressed as a **synchronous vectorized state machine**:
+
+* node state is a pytree of ``[num_nodes, ...]`` device arrays,
+* one ``cycle`` = every node processes at most one mailbox message or
+  fetches at most one instruction (branch-free, masked updates),
+* the message network is a padded ``[num_nodes, capacity]`` ring-buffer
+  tensor; delivery is a vectorized sort+scatter with a *seedable,
+  deterministic* arbitration order replacing the reference's OS-scheduling
+  nondeterminism,
+* scale-out shards the node axis over a ``jax.sharding.Mesh`` with
+  cross-shard delivery via collectives (``parallel/``).
+
+Byte parity: the golden-dump writer (``utils.golden``) reproduces
+``printProcessorState`` (``assignment.c:853-905``) byte for byte, and the
+engine reproduces the reference's observable protocol behavior including
+its quirks (see SURVEY.md "behavioral quirks" and ``ops/handlers.py``).
+"""
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu import types
+
+__version__ = "0.1.0"
+__all__ = ["SystemConfig", "types", "__version__"]

@@ -1,0 +1,133 @@
+"""Command-line entry point.
+
+Compat surface: ``cache-sim <test_directory>`` mirrors the reference's
+``./cache_simulator <test_directory>`` (``assignment.c:126-131``,
+``README.md:108-110``): loads ``tests/<dir>/core_<n>.txt`` relative to
+--tests-root, runs to quiescence, writes ``core_<n>_output.txt`` golden
+dumps into the CWD (or --out-dir).
+
+Beyond the reference: runtime dimensions (--nodes/--cache/--mem/...),
+synthetic workloads (--workload), schedule knobs for interleaving search
+(--delays/--periods/--seed), and metrics reporting (--metrics).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cache-sim",
+        description="TPU-native directory/MESI coherence simulator")
+    p.add_argument("test_dir", nargs="?", default=None,
+                   help="test directory name (reference-compat positional)")
+    p.add_argument("--tests-root", default="tests",
+                   help="prefix for <test_dir> (reference hardcodes 'tests/',"
+                        " assignment.c:824)")
+    p.add_argument("--out-dir", default=".",
+                   help="where to write core_<n>_output.txt dumps")
+    p.add_argument("--workload", choices=["uniform", "producer_consumer",
+                                          "false_sharing"],
+                   help="run a synthetic workload instead of trace files")
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument("--trace-len", type=int, default=32)
+    p.add_argument("--queue-capacity", type=int, default=256)
+    p.add_argument("--seed", type=int, default=0,
+                   help="workload PRNG seed")
+    p.add_argument("--delays", type=int, nargs="*",
+                   help="per-node instruction issue delays (schedule knob)")
+    p.add_argument("--periods", type=int, nargs="*",
+                   help="per-node instruction issue periods (schedule knob)")
+    p.add_argument("--arb-seed", type=int,
+                   help="seed for the cross-sender arbitration permutation "
+                        "(replaces the reference's OS lock-order "
+                        "nondeterminism)")
+    p.add_argument("--admission", type=int, default=None,
+                   help="max concurrent outstanding requests (backpressure "
+                        "window preventing mailbox-overflow livelock; "
+                        "default: reference drop semantics)")
+    p.add_argument("--max-cycles", type=int, default=100_000)
+    p.add_argument("--metrics", action="store_true",
+                   help="print step metrics as JSON to stderr")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend (default: first device)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+    from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
+
+    for knob in ("delays", "periods"):
+        vals = getattr(args, knob)
+        if vals and len(vals) != args.nodes:
+            print(f"error: --{knob} needs one value per node "
+                  f"(got {len(vals)}, --nodes is {args.nodes})",
+                  file=sys.stderr)
+            return 2
+
+    init_kw = {}
+    if args.delays:
+        init_kw["issue_delay"] = np.asarray(args.delays, np.int32)
+    if args.periods:
+        init_kw["issue_period"] = np.asarray(args.periods, np.int32)
+    if args.arb_seed is not None:
+        init_kw["arb_rank"] = np.argsort(
+            np.random.RandomState(args.arb_seed).rand(args.nodes)
+        ).astype(np.int32)
+
+    if args.workload:
+        cfg = SystemConfig.scale(num_nodes=args.nodes,
+                                 queue_capacity=args.queue_capacity,
+                                 admission_window=args.admission)
+        system = CoherenceSystem.from_workload(
+            cfg, args.workload, trace_len=args.trace_len, seed=args.seed,
+            init_kw=init_kw)
+    elif args.test_dir:
+        cfg = SystemConfig.reference(num_nodes=args.nodes,
+                                     admission_window=args.admission)
+        path = os.path.join(args.tests_root, args.test_dir)
+        try:
+            system = CoherenceSystem.from_test_dir(path, cfg, **init_kw)
+        except FileNotFoundError as e:
+            print(e, file=sys.stderr)  # clean exit like assignment.c:826-829
+            return 1
+        for n in range(cfg.num_nodes):
+            print(f"Processor {n} initialized")  # assignment.c:850
+    else:
+        print("error: provide <test_directory> or --workload",
+              file=sys.stderr)
+        return 2
+
+    system = system.run(args.max_cycles)
+    if not system.quiescent:
+        m = system.metrics
+        hint = ""
+        if m["msgs_dropped"] > 0:
+            hint = (f" ({m['msgs_dropped']} messages dropped on full "
+                    "mailboxes — likely livelocked; rerun with --admission "
+                    f"{max(1, cfg.queue_capacity // 6)} or a larger "
+                    "--queue-capacity)")
+        print(f"warning: not quiescent after {args.max_cycles} cycles{hint}",
+              file=sys.stderr)
+
+    if args.test_dir:  # golden dumps only make sense for trace runs
+        system.write_dumps(args.out_dir)
+    if args.metrics:
+        print(json.dumps(system.metrics), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

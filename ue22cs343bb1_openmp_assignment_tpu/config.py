@@ -1,0 +1,140 @@
+"""Runtime system configuration.
+
+The reference freezes all dimensions at compile time
+(``assignment.c:6-10``: NUM_PROCS=4, CACHE_SIZE=4, MEM_SIZE=16,
+MSG_BUFFER_SIZE=256, MAX_INSTR_NUM=32). Here every dimension is a runtime
+parameter so a single TPU chip can step thousands of simulated cores; the
+classmethod :meth:`SystemConfig.reference` reproduces the reference's
+exact dimensions for byte-parity testing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+
+@dataclasses.dataclass(frozen=True)
+class SystemConfig:
+    """Static (compile-time, shape-determining) simulation parameters.
+
+    Hashable and frozen so it can be a `static_argnum` to `jax.jit`.
+    """
+
+    num_nodes: int = 4          # NUM_PROCS (assignment.c:6); parameterized
+    cache_size: int = 4         # direct-mapped lines/node (assignment.c:7)
+    mem_size: int = 16          # memory blocks/node (assignment.c:8)
+    queue_capacity: int = 256   # mailbox slots/node (assignment.c:9)
+    max_instrs: int = 32        # trace length cap/node (assignment.c:10)
+
+    # Message-network semantics -------------------------------------------
+    # 'mailbox': INV fan-out travels through mailboxes (exact reference
+    #            semantics, needs num_nodes out-slots per node — use for
+    #            parity configs, num_nodes <= 64).
+    # 'scatter': INV applied as a direct vectorized scatter in the same
+    #            cycle (the reference already assumes INV never fails and
+    #            tracks no INV-ACKs, assignment.c:358-361; this is the
+    #            scale path for thousands of nodes).
+    inv_mode: str = "mailbox"
+
+    # Overflow policy: 'drop' matches the reference's silent drop on a full
+    # ring (assignment.c:754-762); drops are always counted in metrics.
+    overflow_policy: str = "drop"
+
+    # Admission window (backpressure): maximum number of simultaneously
+    # outstanding request transactions system-wide. The reference silently
+    # drops on overflow (assignment.c:754-762), which at its dimensions is
+    # unreachable but at scale livelocks: a dropped reply leaves its
+    # requester blocked forever (SURVEY quirk 6). With a window W <= Q/6,
+    # no mailbox can overflow (each in-flight transaction enqueues at most
+    # ~6 messages against any single queue), so delivery is drop-free.
+    # None = reference semantics (no gating).
+    admission_window: int | None = None
+
+    def __post_init__(self):
+        if self.num_nodes < 1:
+            raise ValueError("num_nodes must be >= 1")
+        if self.inv_mode not in ("mailbox", "scatter"):
+            raise ValueError(f"bad inv_mode {self.inv_mode!r}")
+        if self.inv_mode == "mailbox" and self.num_nodes > 64:
+            raise ValueError(
+                "inv_mode='mailbox' materializes num_nodes INV out-slots per "
+                "node per cycle; use inv_mode='scatter' above 64 nodes")
+
+    # -- address codec geometry -------------------------------------------
+    @property
+    def block_bits(self) -> int:
+        """Bits of the block index inside an address.
+
+        The reference packs (node, block) into one byte as two nibbles
+        (``assignment.c:46-49,186-188``); with mem_size=16 the low nibble
+        is exactly the block index. Generalized: block field is
+        ceil(log2(mem_size)) bits, node id sits above it.
+        """
+        return max(1, (self.mem_size - 1).bit_length())
+
+    @property
+    def addr_bits(self) -> int:
+        node_bits = max(1, (self.num_nodes - 1).bit_length())
+        return self.block_bits + node_bits
+
+    @property
+    def invalid_address(self) -> int:
+        """Sentinel for an empty cache line.
+
+        The reference uses 0xFF (``assignment.c:815-817``); generalized to
+        an address whose node field is out of range for any valid node.
+        With reference dimensions this is exactly 0xFF.
+        """
+        if self.is_reference_compat:
+            return 0xFF
+        return (1 << (self.addr_bits + 4)) - 1
+
+    @property
+    def bitvec_words(self) -> int:
+        """uint32 words per directory sharer-bitvector (tiled for large N).
+
+        The reference uses a single byte (``assignment.c:63``), capping it
+        at 8 nodes; we tile ceil(N/32) uint32 words.
+        """
+        return max(1, math.ceil(self.num_nodes / 32))
+
+    @property
+    def is_reference_compat(self) -> bool:
+        """True when dimensions match the reference exactly (parity mode)."""
+        return (self.num_nodes <= 8 and self.cache_size == 4
+                and self.mem_size == 16 and self.max_instrs <= 32)
+
+    # Out-slot layout for candidate messages emitted per node per cycle.
+    # Program order within one node's cycle (defines intra-node FIFO order,
+    # mirroring the reference's sequential sendMessage calls):
+    #   slot 0            : primary send (home reply / flush-to-home /
+    #                       frontend request / evict-notify)
+    #   slot 1            : secondary send (FLUSH / FLUSH_INVACK to the
+    #                       secondReceiver, assignment.c:282,498)
+    #   slots 2..2+N-1    : INV fan-out (assignment.c:364-373), mailbox mode
+    #   slot last         : eviction notice (sent after INVs in REPLY_ID,
+    #                       assignment.c:364-378, and alone in other fills)
+    @property
+    def inv_slots(self) -> int:
+        return self.num_nodes if self.inv_mode == "mailbox" else 0
+
+    @property
+    def out_slots(self) -> int:
+        return 3 + self.inv_slots
+
+    @classmethod
+    def reference(cls, **overrides) -> "SystemConfig":
+        """The reference's exact compile-time dimensions (assignment.c:6-10)."""
+        base = dict(num_nodes=4, cache_size=4, mem_size=16,
+                    queue_capacity=256, max_instrs=32, inv_mode="mailbox")
+        base.update(overrides)
+        return cls(**base)
+
+    @classmethod
+    def scale(cls, num_nodes: int, **overrides) -> "SystemConfig":
+        """A large-N benchmark configuration (scatter INV, tiled bitvectors)."""
+        base = dict(num_nodes=num_nodes, cache_size=4, mem_size=16,
+                    queue_capacity=64, max_instrs=32, inv_mode="scatter")
+        base.update(overrides)
+        return cls(**base)

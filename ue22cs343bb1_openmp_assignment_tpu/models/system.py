@@ -1,0 +1,104 @@
+"""CoherenceSystem — the flagship model: a full DASH/MESI directory
+machine as one object.
+
+This is the user-facing equivalent of the reference program as a whole
+(``./cache_simulator <test_dir>``): load traces, run to quiescence, dump
+golden state — plus the capabilities the reference lacks: synthetic
+workloads, schedule control, metrics, checkpointing, arbitrary scale.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.models import workloads
+from ue22cs343bb1_openmp_assignment_tpu.ops.step import (cycle, run_cycles,
+                                                         run_to_quiescence)
+from ue22cs343bb1_openmp_assignment_tpu.state import SimState, init_state
+from ue22cs343bb1_openmp_assignment_tpu.utils import golden, trace
+
+
+@dataclasses.dataclass
+class CoherenceSystem:
+    """A configured coherence machine with its current state."""
+
+    cfg: SystemConfig
+    state: SimState
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def from_test_dir(cls, test_dir: str, cfg: Optional[SystemConfig] = None,
+                      **init_kw) -> "CoherenceSystem":
+        """Load reference-format core_<n>.txt traces (assignment.c:806-851)."""
+        cfg = cfg or SystemConfig.reference()
+        traces = trace.load_test_dir(test_dir, cfg.num_nodes, cfg.max_instrs)
+        return cls(cfg, init_state(cfg, traces, **init_kw))
+
+    @classmethod
+    def from_workload(cls, cfg: SystemConfig, name: str = "uniform",
+                      trace_len: Optional[int] = None, seed: int = 0,
+                      init_kw: Optional[dict] = None,
+                      **gen_kw) -> "CoherenceSystem":
+        """Build from a synthetic workload generator (models.workloads).
+
+        init_kw: forwarded to state.init_state (schedule knobs:
+        issue_delay / issue_period / arb_rank).
+        """
+        trace_len = trace_len or cfg.max_instrs
+        if trace_len != cfg.max_instrs:
+            cfg = dataclasses.replace(cfg, max_instrs=trace_len)
+        arrays = workloads.GENERATORS[name](
+            jax.random.PRNGKey(seed), cfg, trace_len, **gen_kw)
+        return cls(cfg, init_state(cfg, instr_arrays=arrays,
+                                   **(init_kw or {})))
+
+    @classmethod
+    def from_traces(cls, cfg: SystemConfig,
+                    traces: Sequence[Sequence[trace.Instr]],
+                    **init_kw) -> "CoherenceSystem":
+        return cls(cfg, init_state(cfg, list(traces), **init_kw))
+
+    # -- execution ---------------------------------------------------------
+    def step(self) -> "CoherenceSystem":
+        """Advance one cycle (unjitted; for debugging/inspection)."""
+        return dataclasses.replace(self, state=cycle(self.cfg, self.state))
+
+    def run(self, max_cycles: int = 100_000) -> "CoherenceSystem":
+        """Run to quiescence — the fixpoint replacing the reference's
+        spin-forever + SIGINT termination model."""
+        final = run_to_quiescence(self.cfg, self.state, max_cycles)
+        return dataclasses.replace(self, state=final)
+
+    def run_cycles(self, n: int) -> "CoherenceSystem":
+        return dataclasses.replace(self, state=run_cycles(self.cfg,
+                                                          self.state, n))
+
+    # -- observability -----------------------------------------------------
+    @property
+    def quiescent(self) -> bool:
+        return bool(self.state.quiescent())
+
+    @property
+    def metrics(self) -> dict:
+        m = self.state.metrics
+        out = {f.name: jax.device_get(getattr(m, f.name))
+               for f in m.__dataclass_fields__.values()}
+        return {k: (v.tolist() if hasattr(v, "tolist") else v)
+                for k, v in out.items()}
+
+    def dumps(self) -> List[str]:
+        """Per-node golden dumps (printProcessorState byte-parity)."""
+        return [golden.format_node_dump(d)
+                for d in golden.state_to_dumps(self.cfg, self.state)]
+
+    def write_dumps(self, out_dir: str) -> List[str]:
+        return golden.write_dumps(self.cfg, self.state, out_dir)
+
+    @property
+    def instrs_retired(self) -> int:
+        return int(self.state.metrics.instrs_retired)

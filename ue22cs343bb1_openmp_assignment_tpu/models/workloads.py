@@ -1,0 +1,80 @@
+"""Synthetic workload (memory-reference trace) generators.
+
+The reference ships only hand-written fixture traces up to 68
+instructions (``tests/``, SURVEY §6). These generators produce the
+benchmark workloads from BASELINE.json's scaling ladder — uniform-random
+RD/WR, producer-consumer, and false-sharing stress — directly as
+``[num_nodes, trace_len]`` device arrays, on device, from a PRNG key.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ue22cs343bb1_openmp_assignment_tpu import codec
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.types import Op
+
+
+def uniform_random(key, cfg: SystemConfig, trace_len: int,
+                   local_frac: float = 0.8, write_frac: float = 0.5):
+    """Uniform-random RD/WR mix; `local_frac` of accesses hit the node's
+    own home memory, the rest a uniformly random remote node.
+
+    Returns (instr_op, instr_addr, instr_val, instr_count) arrays.
+    """
+    N = cfg.num_nodes
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+    shape = (N, trace_len)
+    is_write = jax.random.uniform(k1, shape) < write_frac
+    op = jnp.where(is_write, int(Op.WRITE), int(Op.READ)).astype(jnp.int32)
+
+    own = jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32)[:, None], shape)
+    remote = jax.random.randint(k2, shape, 0, N, dtype=jnp.int32)
+    node = jnp.where(jax.random.uniform(k3, shape) < local_frac, own, remote)
+    block = jax.random.randint(k4, shape, 0, cfg.mem_size, dtype=jnp.int32)
+    addr = codec.make_address(cfg, node, block)
+    val = jax.random.randint(k5, shape, 0, 256, dtype=jnp.int32)
+    count = jnp.full((N,), trace_len, jnp.int32)
+    return op, addr, val, count
+
+
+def producer_consumer(key, cfg: SystemConfig, trace_len: int,
+                      num_slots: int = 4):
+    """Odd nodes write into even neighbors' memory; even nodes read their
+    own blocks back — a ping-pong ownership-transfer stress."""
+    N = cfg.num_nodes
+    k1, k2 = jax.random.split(key)
+    shape = (N, trace_len)
+    ids = jnp.arange(N, dtype=jnp.int32)[:, None]
+    is_producer = (ids % 2) == 1
+    partner = jnp.where(is_producer, ids - 1, ids)  # producers target left
+    block = jax.random.randint(k1, shape, 0, num_slots, dtype=jnp.int32)
+    addr = codec.make_address(cfg, jnp.broadcast_to(partner, shape), block)
+    op = jnp.where(jnp.broadcast_to(is_producer, shape),
+                   int(Op.WRITE), int(Op.READ)).astype(jnp.int32)
+    val = jax.random.randint(k2, shape, 0, 256, dtype=jnp.int32)
+    return op, addr, val, jnp.full((N,), trace_len, jnp.int32)
+
+
+def false_sharing(key, cfg: SystemConfig, trace_len: int,
+                  num_hot_blocks: int = 2):
+    """Every node hammers the same few blocks of node 0 — maximal
+    invalidation / ownership churn (BASELINE.json 65536-core stress)."""
+    N = cfg.num_nodes
+    k1, k2, k3 = jax.random.split(key, 3)
+    shape = (N, trace_len)
+    block = jax.random.randint(k1, shape, 0, num_hot_blocks, dtype=jnp.int32)
+    addr = codec.make_address(cfg, jnp.zeros(shape, jnp.int32), block)
+    is_write = jax.random.uniform(k2, shape) < 0.5
+    op = jnp.where(is_write, int(Op.WRITE), int(Op.READ)).astype(jnp.int32)
+    val = jax.random.randint(k3, shape, 0, 256, dtype=jnp.int32)
+    return op, addr, val, jnp.full((N,), trace_len, jnp.int32)
+
+
+GENERATORS = {
+    "uniform": uniform_random,
+    "producer_consumer": producer_consumer,
+    "false_sharing": false_sharing,
+}

@@ -1,0 +1,123 @@
+"""Instruction-side protocol: fetch, hit/miss classification, requests.
+
+Reference: the instruction half of the event loop
+(``assignment.c:632-735``). A node fetches its next instruction only when
+its mailbox is empty and it is not blocked on an outstanding request —
+exactly the reference's drain-messages-first priority
+(``assignment.c:165-177,624-629``) expressed cycle-synchronously.
+
+Hit rule (``assignment.c:662-664``): tag match AND state != INVALID.
+* read hit — no work;
+* read miss — READ_REQUEST to home, block;
+* write hit on M/E — write through the cache line, state -> MODIFIED
+  (``assignment.c:705-710``);
+* write hit on S — UPGRADE to home, block (``assignment.c:711-724``);
+* write miss — WRITE_REQUEST (with the value) to home, block.
+
+The issue gate (issue_delay/issue_period) is the *schedule knob* that
+replaces OS thread timing for realizing alternative interleavings on the
+racy suites (test_3/test_4); with delay=0, period=1 it is inert.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ue22cs343bb1_openmp_assignment_tpu import codec
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.state import SimState
+from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, Msg, Op
+
+
+def instruction_phase(cfg: SystemConfig, state: SimState, may_issue):
+    """Compute instruction-fetch effects for nodes in `may_issue`.
+
+    Returns (updates, request_part, stats). `updates` carries the same
+    write-intent layout as handlers.message_phase; `request_part` is the
+    slot-0 candidate contribution (READ_REQUEST / UPGRADE / WRITE_REQUEST).
+    """
+    N = cfg.num_nodes
+    rows = jnp.arange(N, dtype=jnp.int32)
+
+    # schedule gate (inert at delay=0, period=1)
+    since = state.cycle - state.issue_delay
+    gate = (since >= 0) & (since % jnp.maximum(state.issue_period, 1) == 0)
+
+    has_more = state.instr_idx < state.instr_count - 1  # assignment.c:632
+    fetch = may_issue & gate & has_more
+
+    idx = jnp.where(fetch, state.instr_idx + 1, 0)
+    op = state.instr_op[rows, idx]
+    addr = state.instr_addr[rows, idx]
+    val = state.instr_val[rows, idx]
+
+    i_home = codec.home_node(cfg, addr)
+    i_cidx = codec.cache_index(cfg, addr)
+    cl_addr = state.cache_addr[rows, i_cidx]
+    cl_state = state.cache_state[rows, i_cidx]
+
+    is_read = fetch & (op == int(Op.READ))
+    is_write = fetch & (op == int(Op.WRITE))
+    hit = (cl_addr == addr) & (cl_state != int(CacheState.INVALID))
+
+    read_hit = is_read & hit
+    read_miss = is_read & ~hit
+    write_hit_me = is_write & hit & (
+        (cl_state == int(CacheState.MODIFIED))
+        | (cl_state == int(CacheState.EXCLUSIVE)))
+    write_hit_s = is_write & hit & ~write_hit_me  # DEBUG-asserted SHARED
+    write_miss = is_write & ~hit
+
+    # Admission control (backpressure): cap simultaneously outstanding
+    # request transactions so bounded mailboxes can never overflow — the
+    # explicit policy replacing the reference's silent drop (SURVEY §5
+    # "failure detection"). A gated node simply retries the fetch next
+    # cycle (no instr_idx advance, no latch).
+    if cfg.admission_window is not None:
+        wants = read_miss | write_hit_s | write_miss
+        inflight = jnp.sum(state.waiting).astype(jnp.int32)
+        rank = (jnp.cumsum(wants.astype(jnp.int32))
+                - wants.astype(jnp.int32))  # exclusive prefix in node order
+        admit = inflight + rank < cfg.admission_window
+        keep = ~wants | admit
+        fetch = fetch & keep
+        read_miss &= admit
+        write_hit_s &= admit
+        write_miss &= admit
+        read_hit &= keep
+        write_hit_me &= keep
+
+    # local write-through on M/E hit (assignment.c:708-710)
+    cw_mask = write_hit_me
+    updates = dict(
+        cache_idx=i_cidx,
+        cache_state=(cw_mask,
+                     jnp.full((N,), int(CacheState.MODIFIED), jnp.int32)),
+        cache_addr=(jnp.zeros((N,), bool), addr),   # no addr change on hit
+        cache_val=(cw_mask, val),
+        wait_set=read_miss | write_hit_s | write_miss,
+        fetch=fetch,
+        new_idx=jnp.where(fetch, state.instr_idx + 1, state.instr_idx),
+        latch=(fetch, op, addr, val),
+    )
+
+    req_type = jnp.select(
+        [read_miss, write_hit_s, write_miss],
+        [jnp.full((N,), int(Msg.READ_REQUEST), jnp.int32),
+         jnp.full((N,), int(Msg.UPGRADE), jnp.int32),
+         jnp.full((N,), int(Msg.WRITE_REQUEST), jnp.int32)],
+        default=jnp.full((N,), int(Msg.NONE), jnp.int32))
+    # UPGRADE and WRITE_REQUEST carry the value (assignment.c:716-731);
+    # READ_REQUEST does not.
+    req_value = jnp.where(is_write, val, 0)
+    request_part = (req_type, i_home, addr, req_value)
+
+    stats = dict(
+        read_hits=jnp.sum(read_hit).astype(jnp.int32),
+        write_hits=jnp.sum(write_hit_me | write_hit_s).astype(jnp.int32),
+        read_misses=jnp.sum(read_miss).astype(jnp.int32),
+        write_misses=jnp.sum(write_miss).astype(jnp.int32),
+        upgrades=jnp.sum(write_hit_s).astype(jnp.int32),
+        issued=jnp.sum(fetch).astype(jnp.int32),
+    )
+    return updates, request_part, stats

@@ -1,0 +1,260 @@
+"""The 13 protocol message handlers, vectorized over all nodes.
+
+Reference: the ``switch(msg.type)`` at ``assignment.c:190-618``. Every
+handler there mutates only the *processing thread's own* node state and
+communicates exclusively via ``sendMessage`` — which is what makes the
+message phase perfectly data-parallel: one gathered head message per
+node, branch-free masked updates, candidate out-messages in static slots.
+
+Faithfully encodes the reference's behavioral quirks (SURVEY §2):
+
+1. ``REPLY_ID``/``REPLY_WR``/``FLUSH_INVACK`` fill the cache from the
+   node's *latched in-flight instruction value* (``instr.value``,
+   ``assignment.c:383,470,531``), not from the message.
+2. ``FLUSH``/``FLUSH_INVACK`` clear ``waitingForReply`` unconditionally,
+   even on a pure-home receiver (``assignment.c:322,535``).
+3. ``WRITEBACK_INT`` dedups the home==requester double-send
+   (``assignment.c:281``); ``WRITEBACK_INV`` does not
+   (``assignment.c:492-498``).
+4. Read-miss-on-EM leaves the directory untouched until the ``FLUSH``
+   returns (``assignment.c:199-210``); write-miss updates it immediately
+   and unconditionally (``assignment.c:455-457``).
+5. ``EVICT_SHARED`` at a non-home receiver and the home self-promotion
+   path write EXCLUSIVE *without a tag check* (``assignment.c:558,586``),
+   and ``WRITEBACK_INT``/``WRITEBACK_INV`` read/flush the cache line
+   without a tag check — blind-by-index exactly like the C.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ue22cs343bb1_openmp_assignment_tpu import codec
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.ops.mailbox import Candidates, MsgView
+from ue22cs343bb1_openmp_assignment_tpu.state import (SimState, bit_single,
+                                                      ctz, popcount)
+from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState, Msg
+
+
+def message_phase(cfg: SystemConfig, state: SimState, mv: MsgView):
+    """Compute all message-handler effects for this cycle.
+
+    Returns (updates, cand_parts, inv_scatter, stats):
+      updates: dict of per-node write intents (masks + values),
+      cand_parts: dict with primary/secondary/inv/evict candidate fields,
+      inv_scatter: (mask, addr, bitvec) for cfg.inv_mode == 'scatter',
+      stats: dict of metric deltas.
+    """
+    N, W = cfg.num_nodes, cfg.bitvec_words
+    rows = jnp.arange(N, dtype=jnp.int32)
+    has, t = mv.has_msg, mv.type
+
+    # decode (assignment.c:186-188)
+    p_home = codec.home_node(cfg, mv.addr)
+    p_block = codec.block_index(cfg, mv.addr)
+    p_cidx = codec.cache_index(cfg, mv.addr)
+
+    # own-state gathers
+    dirst = state.dir_state[rows, p_block]
+    dirbv = state.dir_bitvec[rows, p_block]          # [N, W]
+    memv = state.memory[rows, p_block]
+    cl_addr = state.cache_addr[rows, p_cidx]
+    cl_val = state.cache_val[rows, p_cidx]
+    cl_state = state.cache_state[rows, p_cidx]
+
+    def m(ty):
+        return has & (t == int(ty))
+
+    is_rr = m(Msg.READ_REQUEST)
+    is_rrd = m(Msg.REPLY_RD)
+    is_wbint = m(Msg.WRITEBACK_INT)
+    is_flush = m(Msg.FLUSH)
+    is_upg = m(Msg.UPGRADE)
+    is_rid = m(Msg.REPLY_ID)
+    is_inv = m(Msg.INV)
+    is_wreq = m(Msg.WRITE_REQUEST)
+    is_rwr = m(Msg.REPLY_WR)
+    is_wbinv = m(Msg.WRITEBACK_INV)
+    is_fia = m(Msg.FLUSH_INVACK)
+    is_es = m(Msg.EVICT_SHARED)
+    is_em = m(Msg.EVICT_MODIFIED)
+
+    at_home = rows == p_home
+    sender_bit = bit_single(W, mv.sender)            # [N, W]
+    second_bit = bit_single(W, mv.second)
+    d_em = dirst == int(DirState.EM)
+    d_s = dirst == int(DirState.S)
+    d_u = dirst == int(DirState.U)
+    owner = ctz(dirbv)                               # current owner if EM
+
+    flush_home = is_flush & at_home
+    flush_second = is_flush & (rows == mv.second)
+    fia_home = is_fia & at_home
+    fia_second = is_fia & (rows == mv.second)
+
+    # EVICT_SHARED home bookkeeping (assignment.c:559-589)
+    es_home = is_es & at_home
+    es_bv2 = dirbv & ~sender_bit
+    es_nsh = popcount(es_bv2)
+    es_new_owner = ctz(es_bv2)
+    es_promote_self = es_home & (es_nsh == 1) & (es_new_owner == rows)
+    es_notify = es_home & (es_nsh == 1) & (es_new_owner != rows)
+
+    # ---- cache fills (REPLY_RD / FLUSH@req / REPLY_ID / REPLY_WR /
+    #      FLUSH_INVACK@req) ------------------------------------------------
+    fill = is_rrd | flush_second | is_rid | is_rwr | fia_second
+    fill_val = jnp.where(is_rrd | flush_second, mv.value, state.cur_val)
+    fill_state = jnp.where(
+        is_rrd,
+        jnp.where(mv.dirstate == int(DirState.S), int(CacheState.SHARED),
+                  int(CacheState.EXCLUSIVE)),
+        jnp.where(flush_second, int(CacheState.SHARED),
+                  int(CacheState.MODIFIED)))
+
+    # eviction of the displaced line (assignment.c:246-249,313-316,376-379,
+    # 467,526-529): tag-mismatch check everywhere except REPLY_WR, which
+    # calls handleCacheReplacement unconditionally (no-op only on INVALID).
+    evict_checked = (is_rrd | flush_second | is_rid | fia_second)
+    evict_fire = ((evict_checked & (cl_addr != mv.addr)
+                   & (cl_state != int(CacheState.INVALID)))
+                  | (is_rwr & (cl_state != int(CacheState.INVALID))))
+
+    # ---- cache state writes ----------------------------------------------
+    inv_hits = is_inv & (cl_addr == mv.addr)
+    cs_mask = (is_wbint | inv_hits | is_wbinv | (is_es & ~at_home)
+               | es_promote_self | fill)
+    cs_val = jnp.select(
+        [fill, is_wbint, inv_hits | is_wbinv],
+        [fill_state,
+         jnp.full((N,), int(CacheState.SHARED), jnp.int32),
+         jnp.full((N,), int(CacheState.INVALID), jnp.int32)],
+        default=jnp.full((N,), int(CacheState.EXCLUSIVE), jnp.int32))
+
+    # ---- directory writes -------------------------------------------------
+    ds_mask = ((is_rr & d_u) | flush_home | is_upg | is_wreq
+               | (es_home & (es_nsh <= 1)) | is_em)
+    ds_val = jnp.select(
+        [flush_home,
+         (es_home & (es_nsh == 0)) | is_em],
+        [jnp.full((N,), int(DirState.S), jnp.int32),
+         jnp.full((N,), int(DirState.U), jnp.int32)],
+        default=jnp.full((N,), int(DirState.EM), jnp.int32))
+
+    dbv_mask = ((is_rr & (d_s | d_u)) | flush_home | is_upg | is_wreq
+                | fia_home | es_home | is_em)
+    dbv_val = jnp.select(
+        [(is_rr & d_s)[:, None] | flush_home[:, None],
+         (is_rr & d_u)[:, None] | is_upg[:, None] | is_wreq[:, None],
+         fia_home[:, None],
+         es_home[:, None]],
+        [dirbv | jnp.where(flush_home[:, None], second_bit, sender_bit),
+         sender_bit,
+         second_bit,
+         es_bv2],
+        default=jnp.zeros_like(dirbv))
+
+    # ---- memory writes (assignment.c:307,520,602) -------------------------
+    mem_mask = flush_home | fia_home | is_em
+    mem_val = mv.value
+
+    # ---- waiting flag (quirk 2: FLUSH/FLUSH_INVACK unconditional) ---------
+    wait_clear = is_rrd | is_flush | is_rid | is_rwr | is_fia
+
+    updates = dict(
+        cache_idx=p_cidx, cache_state=(cs_mask, cs_val),
+        cache_addr=(fill, mv.addr), cache_val=(fill, fill_val),
+        mem=(mem_mask, p_block, mem_val),
+        dir_state=(ds_mask, p_block, ds_val),
+        dir_bv=(dbv_mask, p_block, dbv_val),
+        wait_clear=wait_clear,
+    )
+
+    # ---- candidate out-messages ------------------------------------------
+    none = jnp.full((N,), int(Msg.NONE), jnp.int32)
+    zero = jnp.zeros((N,), jnp.int32)
+    zbv = jnp.zeros((N, W), jnp.uint32)
+    others_bv = dirbv & ~sender_bit  # UPGRADE / WRITE_REQUEST@S sharer list
+
+    # primary send (slot 0) — each handler's first sendMessage
+    pri_mask = is_rr | is_wbint | is_upg | is_wreq | is_wbinv | es_notify
+    pri_type = jnp.select(
+        [is_rr & d_em, is_rr, is_wbint,
+         is_upg | (is_wreq & d_s), is_wreq & d_u, is_wreq,
+         is_wbinv, es_notify],
+        [jnp.full((N,), int(Msg.WRITEBACK_INT), jnp.int32),
+         jnp.full((N,), int(Msg.REPLY_RD), jnp.int32),
+         jnp.full((N,), int(Msg.FLUSH), jnp.int32),
+         jnp.full((N,), int(Msg.REPLY_ID), jnp.int32),
+         jnp.full((N,), int(Msg.REPLY_WR), jnp.int32),
+         jnp.full((N,), int(Msg.WRITEBACK_INV), jnp.int32),
+         jnp.full((N,), int(Msg.FLUSH_INVACK), jnp.int32),
+         jnp.full((N,), int(Msg.EVICT_SHARED), jnp.int32)],
+        default=none)
+    pri_type = jnp.where(pri_mask, pri_type, none)
+    pri_recv = jnp.select(
+        [is_rr & d_em, is_rr | is_upg, is_wbint | is_wbinv,
+         is_wreq & d_em, is_wreq, es_notify],
+        [owner, mv.sender, p_home, owner, mv.sender, es_new_owner],
+        default=zero)
+    pri_value = jnp.select(
+        [is_rr & d_em, is_rr, is_wbint | is_wbinv, is_wreq & d_em, es_notify],
+        [zero, memv, cl_val, mv.value, memv], default=zero)
+    pri_second = jnp.select(
+        [is_rr & d_em, is_wreq & d_em, is_wbint | is_wbinv],
+        [mv.sender, mv.sender, mv.second], default=zero)
+    pri_dirstate = jnp.where(is_rr & d_s, int(DirState.S), int(DirState.EM))
+    pri_bitvec = jnp.where((is_upg | (is_wreq & d_s))[:, None], others_bv, zbv)
+
+    # secondary send (slot 1): FLUSH / FLUSH_INVACK to the secondReceiver.
+    # WRITEBACK_INT dedups home==requester; WRITEBACK_INV does not (quirk 3).
+    sec_mask = (is_wbint & (p_home != mv.second)) | is_wbinv
+    sec_type = jnp.where(
+        sec_mask,
+        jnp.where(is_wbint, int(Msg.FLUSH), int(Msg.FLUSH_INVACK)), none)
+    sec_recv = mv.second
+    sec_value = cl_val
+    sec_second = mv.second
+
+    # INV fan-out (assignment.c:364-373): mailbox mode materializes one
+    # slot per potential target; scatter mode returns the payload for a
+    # dense cross-node application in the step.
+    if cfg.inv_mode == "mailbox":
+        targets = jnp.arange(N, dtype=jnp.int32)
+        tw, tb = targets // 32, (targets % 32).astype(jnp.uint32)
+        bits = (mv.bitvec[:, tw] >> tb[None, :]) & 1        # [N, N]
+        inv_mask = is_rid[:, None] & (bits == 1)
+        inv_type = jnp.where(inv_mask, int(Msg.INV), int(Msg.NONE))
+        inv_recv = jnp.broadcast_to(targets[None, :], (N, N))
+        inv_addr = jnp.broadcast_to(mv.addr[:, None], (N, N))
+        inv_scatter = None
+    else:
+        inv_type = inv_recv = inv_addr = None
+        inv_scatter = (is_rid, mv.addr, mv.bitvec)
+
+    # eviction notice (last slot) — handleCacheReplacement
+    # (assignment.c:767-804): EVICT_MODIFIED carries the dirty value.
+    ev_mod = evict_fire & (cl_state == int(CacheState.MODIFIED))
+    ev_type = jnp.where(
+        evict_fire,
+        jnp.where(ev_mod, int(Msg.EVICT_MODIFIED), int(Msg.EVICT_SHARED)),
+        none)
+    ev_recv = codec.home_node(cfg, cl_addr)
+    ev_addr = cl_addr
+    ev_value = jnp.where(ev_mod, cl_val, 0)
+
+    cand_parts = dict(
+        pri=(pri_type, pri_recv, mv.addr, pri_value, pri_second,
+             pri_dirstate, pri_bitvec),
+        sec=(sec_type, sec_recv, mv.addr, sec_value, sec_second),
+        inv=(inv_type, inv_recv, inv_addr),
+        ev=(ev_type, ev_recv, ev_addr, ev_value),
+    )
+
+    stats = dict(
+        msg_type_onehot=(has, t),
+        invalidations=jnp.sum(inv_hits).astype(jnp.int32),
+        evictions=jnp.sum(evict_fire).astype(jnp.int32),
+        unblocked=wait_clear & state.waiting,
+    )
+    return updates, cand_parts, inv_scatter, stats

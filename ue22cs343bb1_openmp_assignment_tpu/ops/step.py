@@ -1,0 +1,223 @@
+"""The simulation cycle and its runners.
+
+One :func:`cycle` = the TPU-native equivalent of one trip around the
+reference's per-thread event loop (``assignment.c:165-737``), for *all*
+nodes at once:
+
+  phase 1  every node with a queued message dequeues exactly one and runs
+           its handler (ops.handlers) — masked, branch-free;
+  phase 2  every idle, unblocked node fetches one instruction
+           (ops.frontend) — a node never does both in one cycle, which
+           preserves the reference's drain-before-fetch priority;
+  phase 3  all candidate messages are delivered into the rings by one
+           arbitration-sorted scatter (ops.mailbox), and (scatter mode)
+           INV fan-out is applied as a dense cross-node invalidation.
+
+Termination is a clean fixpoint (state.quiescent()) instead of the
+reference's spin-forever + external ``kill -9`` (``assignment.c:639-645``,
+``test3.sh:11``): at quiescence the state equals the final re-armed golden
+dump.
+
+Everything here is `jit`-compiled with `cfg` static; runners use
+`lax.scan` / `lax.while_loop` so arbitrarily long traces never unroll.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.ops import frontend, handlers, mailbox
+from ue22cs343bb1_openmp_assignment_tpu.state import SimState
+from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, Msg
+
+
+def cycle(cfg: SystemConfig, state: SimState) -> SimState:
+    """Advance the whole machine by one cycle.
+
+    Cross-sender arbitration order for this cycle's deliveries comes from
+    ``state.arb_rank`` (see ops.mailbox.deliver and state.SimState) — the
+    seedable schedule knob; identity by default.
+    """
+    N, W = cfg.num_nodes, cfg.bitvec_words
+    rows = jnp.arange(N, dtype=jnp.int32)
+    arb_rank = state.arb_rank
+
+    # ---- phase 1: message handlers ---------------------------------------
+    mv, new_head, new_count = mailbox.dequeue(cfg, state)
+    m_upd, m_cand, inv_scatter, m_stats = handlers.message_phase(
+        cfg, state, mv)
+
+    # ---- phase 2: instruction frontend (only message-idle, unblocked) ----
+    may_issue = ~mv.has_msg & ~state.waiting
+    f_upd, f_req, f_stats = frontend.instruction_phase(cfg, state, may_issue)
+
+    # ---- merge write intents (disjoint by node: msg XOR instr) -----------
+    C = cfg.cache_size
+    cidx = jnp.where(mv.has_msg, m_upd["cache_idx"], f_upd["cache_idx"])
+
+    def scatter_cache(arr, m_int, f_int):
+        mask = jnp.where(mv.has_msg, m_int[0], f_int[0])
+        val = jnp.where(mv.has_msg, m_int[1], f_int[1])
+        safe = jnp.where(mask, cidx, C)
+        return arr.at[rows, safe].set(val, mode="drop")
+
+    cache_state = scatter_cache(state.cache_state, m_upd["cache_state"],
+                                f_upd["cache_state"])
+    cache_addr = scatter_cache(state.cache_addr, m_upd["cache_addr"],
+                               f_upd["cache_addr"])
+    cache_val = scatter_cache(state.cache_val, m_upd["cache_val"],
+                              f_upd["cache_val"])
+
+    M = cfg.mem_size
+    mm, mi, mval = m_upd["mem"]
+    memory = state.memory.at[rows, jnp.where(mm, mi, M)].set(
+        mval, mode="drop")
+    dm, di, dval = m_upd["dir_state"]
+    dir_state = state.dir_state.at[rows, jnp.where(dm, di, M)].set(
+        dval, mode="drop")
+    bm, bi, bval = m_upd["dir_bv"]
+    dir_bitvec = state.dir_bitvec.at[rows, jnp.where(bm, bi, M)].set(
+        bval, mode="drop")
+
+    waiting = (state.waiting & ~m_upd["wait_clear"]) | f_upd["wait_set"]
+
+    fetch, l_op, l_addr, l_val = f_upd["latch"]
+    cur_op = jnp.where(fetch, l_op, state.cur_op)
+    cur_addr = jnp.where(fetch, l_addr, state.cur_addr)
+    cur_val = jnp.where(fetch, l_val, state.cur_val)
+
+    # ---- assemble candidates ---------------------------------------------
+    S = cfg.out_slots
+    zero = jnp.zeros((N,), jnp.int32)
+    zbv = jnp.zeros((N, W), jnp.uint32)
+    pt, pr, pa, pv, ps, pd, pb = m_cand["pri"]
+    # slot 0 is shared: message-phase primary XOR frontend request
+    rt, rr_, ra, rv = f_req
+    use_req = ~mv.has_msg
+    s0_type = jnp.where(use_req, rt, pt)
+    s0_recv = jnp.where(use_req, rr_, pr)
+    s0_addr = jnp.where(use_req, ra, pa)
+    s0_value = jnp.where(use_req, rv, pv)
+    s0_second = jnp.where(use_req, zero, ps)
+    s0_dirstate = jnp.where(use_req, zero, pd)
+    s0_bitvec = jnp.where(use_req[:, None], zbv, pb)
+
+    st_, sr_, sa_, sv_, ss_ = m_cand["sec"]
+    et_, er_, ea_, ev_ = m_cand["ev"]
+
+    def stack(slots):
+        return jnp.stack(slots, axis=1)  # [N, S]
+
+    if cfg.inv_mode == "mailbox":
+        it_, ir_, ia_ = m_cand["inv"]
+        c_type = jnp.concatenate(
+            [stack([s0_type, st_]), it_, et_[:, None]], axis=1)
+        c_recv = jnp.concatenate(
+            [stack([s0_recv, sr_]), ir_, er_[:, None]], axis=1)
+        c_addr = jnp.concatenate(
+            [stack([s0_addr, sa_]), ia_, ea_[:, None]], axis=1)
+        c_value = jnp.concatenate(
+            [stack([s0_value, sv_]), jnp.zeros((N, N), jnp.int32),
+             ev_[:, None]], axis=1)
+        c_second = jnp.concatenate(
+            [stack([s0_second, ss_]), jnp.zeros((N, N), jnp.int32),
+             zero[:, None]], axis=1)
+        c_dirstate = jnp.concatenate(
+            [stack([s0_dirstate, zero]), jnp.zeros((N, N), jnp.int32),
+             zero[:, None]], axis=1)
+        c_bitvec = jnp.concatenate(
+            [jnp.stack([s0_bitvec, zbv], axis=1),
+             jnp.zeros((N, N, W), jnp.uint32), zbv[:, None]], axis=1)
+    else:
+        c_type = stack([s0_type, st_, et_])
+        c_recv = stack([s0_recv, sr_, er_])
+        c_addr = stack([s0_addr, sa_, ea_])
+        c_value = stack([s0_value, sv_, ev_])
+        c_second = stack([s0_second, ss_, zero])
+        c_dirstate = stack([s0_dirstate, zero, zero])
+        c_bitvec = jnp.stack([s0_bitvec, zbv, zbv], axis=1)
+
+    cand = mailbox.Candidates(
+        type=c_type, recv=c_recv,
+        sender=jnp.broadcast_to(rows[:, None], c_type.shape),
+        addr=c_addr, value=c_value, second=c_second, dirstate=c_dirstate,
+        bitvec=c_bitvec)
+
+    # ---- phase 3: delivery -----------------------------------------------
+    mb_upd, dropped = mailbox.deliver(cfg, state, cand, arb_rank,
+                                      new_head, new_count)
+
+    # dense INV application (scale path; reference assumes INV never
+    # fails and tracks no acks, assignment.c:358-361)
+    inv_applied = jnp.zeros((), jnp.int32)
+    if inv_scatter is not None:
+        im, ia, ibv = inv_scatter
+        # bit of target t in source s's vector: [N_src, N_tgt]
+        tw, tb = rows // 32, (rows % 32).astype(jnp.uint32)
+        bits = (ibv[:, tw] >> tb[None, :]) & 1
+        targeted = im[:, None] & (bits == 1)                 # [S, T]
+        # line c of target t dies if any source targets t with its tag
+        match = (cache_addr[None, :, :] == ia[:, None, None])  # [S, T, C]
+        kill = jnp.any(targeted[:, :, None] & match, axis=0)   # [T, C]
+        inv_applied = jnp.sum(
+            kill & (cache_state != int(CacheState.INVALID))).astype(jnp.int32)
+        cache_state = jnp.where(kill, int(CacheState.INVALID), cache_state)
+
+    # ---- metrics ---------------------------------------------------------
+    mt = state.metrics
+    has, t = m_stats["msg_type_onehot"]
+    msgs = mt.msgs_processed.at[jnp.where(has, t, 13)].add(1, mode="drop")
+    metrics = mt.replace(
+        cycles=mt.cycles + 1,
+        instrs_retired=mt.instrs_retired + f_stats["issued"],
+        read_hits=mt.read_hits + f_stats["read_hits"],
+        write_hits=mt.write_hits + f_stats["write_hits"],
+        read_misses=mt.read_misses + f_stats["read_misses"],
+        write_misses=mt.write_misses + f_stats["write_misses"],
+        upgrades=mt.upgrades + f_stats["upgrades"],
+        msgs_processed=msgs,
+        msgs_dropped=mt.msgs_dropped + dropped,
+        invalidations=mt.invalidations + m_stats["invalidations"]
+        + inv_applied,
+        evictions=mt.evictions + m_stats["evictions"],
+    )
+
+    return state.replace(
+        cache_addr=cache_addr, cache_val=cache_val, cache_state=cache_state,
+        memory=memory, dir_state=dir_state, dir_bitvec=dir_bitvec,
+        instr_idx=f_upd["new_idx"],
+        cur_op=cur_op, cur_addr=cur_addr, cur_val=cur_val, waiting=waiting,
+        cycle=state.cycle + 1, metrics=metrics, **mb_upd)
+
+
+# -- runners ---------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def run_cycles(cfg: SystemConfig, state: SimState,
+               num_cycles: int) -> SimState:
+    """Run a fixed number of cycles under lax.scan (bench path)."""
+
+    def body(s, _):
+        return cycle(cfg, s), None
+
+    state, _ = jax.lax.scan(body, state, None, length=num_cycles)
+    return state
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def run_to_quiescence(cfg: SystemConfig, state: SimState,
+                      max_cycles: int = 100_000) -> SimState:
+    """Run until no work remains (or max_cycles as a safety net).
+
+    Replaces the reference's sleep-1s-then-kill harness
+    (``test3.sh:9-12``) with an exact fixpoint.
+    """
+
+    def cond(s):
+        return (~s.quiescent()) & (s.cycle < max_cycles)
+
+    return jax.lax.while_loop(cond, lambda s: cycle(cfg, s), state)

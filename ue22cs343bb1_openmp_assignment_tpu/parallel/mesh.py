@@ -1,0 +1,47 @@
+"""Device mesh and sharding layout for the simulated-node axis.
+
+The reference's only scaling axis is OpenMP threads inside one process
+(``assignment.c:135,149``). Here the equivalent axis is the simulated-
+node dimension (axis 0 of every SimState array), sharded over a 1-D
+``jax.sharding.Mesh`` named ``'nodes'``:
+
+* per-node state (caches, memories, directories, traces, mailboxes) is
+  fully partitioned — a device owns its shard of nodes end to end,
+* scalar fields (cycle counter, reduced metrics) are replicated,
+* the mailbox-delivery scatter crosses shard boundaries whenever a
+  message's receiver lives on another device; under `jit` XLA/GSPMD
+  lowers that into all-to-all/collective-permute traffic on ICI (DCN
+  across hosts) — the framework's distributed communication backend.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS = "nodes"
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A 1-D mesh over `devices` (default: all) with axis 'nodes'."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(devices, (AXIS,))
+
+
+def state_shardings(cfg, mesh: Mesh, state):
+    """NamedShardings for a SimState pytree: shard axis 0 when it is the
+    node axis, replicate everything else."""
+
+    def spec(x):
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == cfg.num_nodes:
+            return NamedSharding(mesh, P(AXIS, *([None] * (x.ndim - 1))))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(spec, state)
+
+
+def shard_state(cfg, mesh: Mesh, state):
+    """Place a host-built SimState onto the mesh."""
+    return jax.device_put(state, state_shardings(cfg, mesh, state))

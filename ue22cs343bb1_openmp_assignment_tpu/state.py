@@ -1,0 +1,266 @@
+"""Simulation state as a pytree of ``[num_nodes, ...]`` device arrays.
+
+The reference keeps one ``processorNode`` struct per OpenMP thread
+(``assignment.c:89-95``) plus global locked message rings
+(``assignment.c:81-105``). Here the entire machine is one pytree:
+
+* axis 0 of every array is the simulated-node axis — this is the axis
+  that is vectorized on one chip and sharded across a device mesh,
+* the mailbox is a per-node circular ring exactly like the reference's
+  ``messageBuffer`` (head/count, capacity ``cfg.queue_capacity``), but as
+  a padded tensor written by a vectorized scatter instead of locks,
+* the sharer bitvector is tiled into uint32 words (``cfg.bitvec_words``)
+  instead of the reference's single byte (``assignment.c:63``) so the
+  directory scales past 8 nodes to tens of thousands.
+
+All fields use int32/uint32: TPU-friendly, and every protocol quantity
+(byte values, nibble addresses, states) embeds losslessly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState, Msg, Op
+
+
+class Metrics(struct.PyTreeNode):
+    """Device-side counters, reduced across nodes (SURVEY §5 observability)."""
+
+    cycles: jnp.ndarray          # [] i32 — cycles executed
+    instrs_retired: jnp.ndarray  # [] i32 — instructions completed (hit or fill)
+    read_hits: jnp.ndarray       # [] i32
+    write_hits: jnp.ndarray      # [] i32
+    read_misses: jnp.ndarray     # [] i32
+    write_misses: jnp.ndarray    # [] i32
+    upgrades: jnp.ndarray        # [] i32 — S write-hits (UPGRADE sent)
+    msgs_processed: jnp.ndarray  # [13] i32 — dequeues by transaction type
+    msgs_dropped: jnp.ndarray    # [] i32 — ring-overflow drops (quirk 6)
+    invalidations: jnp.ndarray   # [] i32 — INV applications that hit a line
+    evictions: jnp.ndarray       # [] i32 — EVICT_* notices sent
+
+    @classmethod
+    def zeros(cls) -> "Metrics":
+        z = jnp.zeros((), jnp.int32)
+        return cls(cycles=z, instrs_retired=z, read_hits=z, write_hits=z,
+                   read_misses=z, write_misses=z, upgrades=z,
+                   msgs_processed=jnp.zeros((13,), jnp.int32),
+                   msgs_dropped=z, invalidations=z, evictions=z)
+
+
+class SimState(struct.PyTreeNode):
+    """Full machine state. Shapes: N nodes, C cache lines, M memory blocks,
+    T max trace length, Q mailbox capacity, W bitvector words."""
+
+    # -- per-node cache (reference cacheLine[], assignment.c:56-60,90) ----
+    cache_addr: jnp.ndarray    # [N, C] i32, cfg.invalid_address when empty
+    cache_val: jnp.ndarray     # [N, C] i32 (byte-valued)
+    cache_state: jnp.ndarray   # [N, C] i32, CacheState
+
+    # -- per-node home memory + directory (assignment.c:62-66,91-92) ------
+    memory: jnp.ndarray        # [N, M] i32 (byte-valued)
+    dir_state: jnp.ndarray     # [N, M] i32, DirState
+    dir_bitvec: jnp.ndarray    # [N, M, W] u32 sharer bits (bit g of word
+                               #   g//32 = node g caches this block)
+
+    # -- per-node instruction trace (assignment.c:50-54,93-94) ------------
+    instr_op: jnp.ndarray      # [N, T] i32, Op
+    instr_addr: jnp.ndarray    # [N, T] i32
+    instr_val: jnp.ndarray     # [N, T] i32
+    instr_count: jnp.ndarray   # [N] i32
+    instr_idx: jnp.ndarray     # [N] i32, last fetched (init -1, assignment.c:160)
+
+    # latched in-flight instruction — the reference's thread-local `instr`
+    # (assignment.c:159,647); handlers read it for fill values (quirk 1).
+    cur_op: jnp.ndarray        # [N] i32
+    cur_addr: jnp.ndarray      # [N] i32
+    cur_val: jnp.ndarray       # [N] i32
+    waiting: jnp.ndarray       # [N] bool — waitingForReply (assignment.c:162)
+
+    # -- mailboxes (reference messageBuffer, assignment.c:81-87) ----------
+    mb_type: jnp.ndarray       # [N, Q] i32, Msg (NONE = empty slot)
+    mb_sender: jnp.ndarray     # [N, Q] i32
+    mb_addr: jnp.ndarray       # [N, Q] i32
+    mb_value: jnp.ndarray      # [N, Q] i32
+    mb_second: jnp.ndarray     # [N, Q] i32
+    mb_dirstate: jnp.ndarray   # [N, Q] i32
+    mb_bitvec: jnp.ndarray     # [N, Q, W] u32 (REPLY_ID sharer payload)
+    mb_head: jnp.ndarray       # [N] i32
+    mb_count: jnp.ndarray      # [N] i32
+
+    # -- schedule / arbitration knobs (replaces OS nondeterminism) --------
+    # A node issues instructions only when cycle >= delay and
+    # (cycle - delay) % period == 0. Message processing is never gated.
+    # These realize alternative interleavings for the racy suites
+    # (test_3/test_4) as a searchable parameter instead of wall-clock
+    # retries (SURVEY §4).
+    issue_delay: jnp.ndarray   # [N] i32
+    issue_period: jnp.ndarray  # [N] i32 (>= 1)
+    # Cross-sender arbitration rank: when several nodes' messages hit one
+    # receiver in a cycle, lower-rank senders enqueue first — the
+    # deterministic, seedable stand-in for the reference's OS
+    # lock-acquisition order (quirk source for test_3/test_4).
+    arb_rank: jnp.ndarray      # [N] i32 permutation of node ids
+
+    cycle: jnp.ndarray         # [] i32
+    metrics: Metrics
+
+    # ------------------------------------------------------------------
+    @property
+    def num_nodes(self) -> int:
+        return self.cache_addr.shape[0]
+
+    def quiescent(self) -> jnp.ndarray:
+        """True when no message is queued, no node blocked, traces done.
+
+        Replaces the reference's never-terminating spin + external SIGINT
+        (``assignment.c:639-645``, ``test3.sh:11``) with a clean fixpoint:
+        at quiescence the state equals the reference's final re-armed dump
+        (``assignment.c:171-173,635-638``).
+        """
+        exhausted = self.instr_idx >= self.instr_count - 1
+        return (jnp.all(self.mb_count == 0) & jnp.all(~self.waiting)
+                & jnp.all(exhausted))
+
+
+def init_state(cfg: SystemConfig, traces=None, issue_delay=None,
+               issue_period=None, instr_arrays=None,
+               arb_rank=None) -> SimState:
+    """Build the initial machine state.
+
+    Mirrors ``initializeProcessor`` (``assignment.c:806-851``): memory
+    block *i* of node *t* starts at ``(20*t + i) & 0xFF``, directory
+    entries start Unowned with empty bitvectors, cache lines start INVALID
+    with the sentinel address.
+
+    ``traces``: optional list (len <= N) of per-node instruction lists
+    ``[(op, addr, value), ...]`` (see utils.trace for file loading).
+    ``instr_arrays``: optional pre-built device arrays
+    ``(op [N,T], addr [N,T], val [N,T], count [N])`` from a workload
+    generator (models.workloads) — takes precedence over ``traces``.
+    """
+    N, C, M = cfg.num_nodes, cfg.cache_size, cfg.mem_size
+    T, Q, W = cfg.max_instrs, cfg.queue_capacity, cfg.bitvec_words
+
+    node_ids = jnp.arange(N, dtype=jnp.int32)
+    mem_init = (20 * node_ids[:, None]
+                + jnp.arange(M, dtype=jnp.int32)[None, :]) & 0xFF
+
+    instr_op = jnp.full((N, T), int(Op.NOP), jnp.int32)
+    instr_addr = jnp.zeros((N, T), jnp.int32)
+    instr_val = jnp.zeros((N, T), jnp.int32)
+    instr_count = jnp.zeros((N,), jnp.int32)
+    if instr_arrays is not None:
+        instr_op, instr_addr, instr_val, instr_count = (
+            jnp.asarray(a, jnp.int32) for a in instr_arrays)
+        T = instr_op.shape[1]
+        if T != cfg.max_instrs:
+            raise ValueError(
+                f"instr_arrays trace length {T} != cfg.max_instrs "
+                f"{cfg.max_instrs}")
+    elif traces is not None:
+        import numpy as np
+        op_h = np.full((N, T), int(Op.NOP), np.int32)
+        ad_h = np.zeros((N, T), np.int32)
+        va_h = np.zeros((N, T), np.int32)
+        cnt_h = np.zeros((N,), np.int32)
+        for n, tr in enumerate(traces):
+            tr = tr[:T]
+            cnt_h[n] = len(tr)
+            for i, (op, addr, val) in enumerate(tr):
+                op_h[n, i] = int(op)
+                ad_h[n, i] = int(addr)
+                va_h[n, i] = int(val) & 0xFF
+        instr_op, instr_addr = jnp.asarray(op_h), jnp.asarray(ad_h)
+        instr_val, instr_count = jnp.asarray(va_h), jnp.asarray(cnt_h)
+
+    if issue_delay is None:
+        issue_delay = jnp.zeros((N,), jnp.int32)
+    if issue_period is None:
+        issue_period = jnp.ones((N,), jnp.int32)
+    if arb_rank is None:
+        arb_rank = jnp.arange(N, dtype=jnp.int32)
+
+    return SimState(
+        cache_addr=jnp.full((N, C), cfg.invalid_address, jnp.int32),
+        cache_val=jnp.zeros((N, C), jnp.int32),
+        cache_state=jnp.full((N, C), int(CacheState.INVALID), jnp.int32),
+        memory=mem_init,
+        dir_state=jnp.full((N, M), int(DirState.U), jnp.int32),
+        dir_bitvec=jnp.zeros((N, M, W), jnp.uint32),
+        instr_op=instr_op, instr_addr=instr_addr, instr_val=instr_val,
+        instr_count=instr_count,
+        instr_idx=jnp.full((N,), -1, jnp.int32),
+        cur_op=jnp.zeros((N,), jnp.int32),
+        cur_addr=jnp.zeros((N,), jnp.int32),
+        cur_val=jnp.zeros((N,), jnp.int32),
+        waiting=jnp.zeros((N,), bool),
+        mb_type=jnp.full((N, Q), int(Msg.NONE), jnp.int32),
+        mb_sender=jnp.zeros((N, Q), jnp.int32),
+        mb_addr=jnp.zeros((N, Q), jnp.int32),
+        mb_value=jnp.zeros((N, Q), jnp.int32),
+        mb_second=jnp.zeros((N, Q), jnp.int32),
+        mb_dirstate=jnp.zeros((N, Q), jnp.int32),
+        mb_bitvec=jnp.zeros((N, Q, W), jnp.uint32),
+        mb_head=jnp.zeros((N,), jnp.int32),
+        mb_count=jnp.zeros((N,), jnp.int32),
+        issue_delay=jnp.asarray(issue_delay, jnp.int32),
+        issue_period=jnp.asarray(issue_period, jnp.int32),
+        arb_rank=jnp.asarray(arb_rank, jnp.int32),
+        cycle=jnp.zeros((), jnp.int32),
+        metrics=Metrics.zeros(),
+    )
+
+
+# -- bitvector helpers (tiled uint32 words; reference used one byte) ------
+
+def bit_get(bv: jnp.ndarray, node) -> jnp.ndarray:
+    """bv[..., W] -> bool: is `node`'s bit set (vectorized over leading dims)."""
+    word = node // 32
+    off = node % 32
+    w = jnp.take_along_axis(bv, word[..., None].astype(jnp.int32),
+                            axis=-1)[..., 0]
+    return ((w >> off.astype(jnp.uint32)) & 1).astype(bool)
+
+
+def bit_set(bv: jnp.ndarray, node, on=True) -> jnp.ndarray:
+    """Return bv with `node`'s bit set/cleared."""
+    W = bv.shape[-1]
+    words = jnp.arange(W, dtype=jnp.int32)
+    mask = (words == (node[..., None] // 32)).astype(jnp.uint32)
+    bit = mask << jnp.asarray(node[..., None] % 32, jnp.uint32)
+    if on:
+        return bv | bit
+    return bv & ~bit
+
+
+def bit_single(num_words: int, node) -> jnp.ndarray:
+    """A bitvector with exactly `node`'s bit set; node: [...] -> [..., W]."""
+    words = jnp.arange(num_words, dtype=jnp.int32)
+    mask = (words == (node[..., None] // 32)).astype(jnp.uint32)
+    return mask << jnp.asarray(node[..., None] % 32, jnp.uint32)
+
+
+def popcount(bv: jnp.ndarray) -> jnp.ndarray:
+    """Number of set bits; bv [..., W] -> [...] i32 (assignment.c:564)."""
+    return jnp.sum(jax_popcount32(bv), axis=-1).astype(jnp.int32)
+
+
+def jax_popcount32(x: jnp.ndarray) -> jnp.ndarray:
+    import jax
+    return jax.lax.population_count(x).astype(jnp.int32)
+
+
+def ctz(bv: jnp.ndarray) -> jnp.ndarray:
+    """Index of lowest set bit (assignment.c:209 __builtin_ctz); bv [..., W].
+
+    Returns num_bits if empty (caller must mask)."""
+    import jax
+    W = bv.shape[-1]
+    tz = jax.lax.clz(bv & (~bv + jnp.uint32(1)))  # clz of isolated low bit
+    word_ctz = jnp.where(bv == 0, 32, 31 - tz.astype(jnp.int32))
+    base = jnp.arange(W, dtype=jnp.int32) * 32
+    cand = jnp.where(bv == 0, jnp.int32(32 * W), base + word_ctz)
+    return jnp.min(cand, axis=-1)
