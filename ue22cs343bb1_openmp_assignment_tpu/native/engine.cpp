@@ -241,6 +241,10 @@ class Engine {
     });
     for (size_t i : order) {
       int32_t r = pending_[i].first;
+      // Out-of-range receiver (e.g. owner lookup on an empty sharer set
+      // returns the bit-width sentinel, state.py:ctz): the JAX engine's
+      // delivery scatter drops these uncounted (mode="drop"); match it.
+      if (r < 0 || r >= n_) continue;
       if (int32_t(queues_[r].size()) < q_) {
         queues_[r].push_back(std::move(pending_[i].second));
       } else {

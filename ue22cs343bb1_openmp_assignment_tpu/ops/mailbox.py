@@ -111,8 +111,12 @@ def deliver(cfg: SystemConfig, state, cand: Candidates, arb_rank,
     F = N * S
 
     c_type = cand.type.reshape(F)
-    valid = c_type != int(Msg.NONE)
     recv = cand.recv.reshape(F)
+    # Out-of-range receivers (owner lookup on an empty sharer set yields
+    # the ctz sentinel 32*W) are dropped here, uncounted — explicitly, so
+    # the capacity gather below never reads a clamped index and the
+    # native engine's matching guard (engine.cpp deliver) stays exact.
+    valid = (c_type != int(Msg.NONE)) & (recv >= 0) & (recv < N)
     # priority: sender's arbitration rank, then program order (slot)
     prio = arb_rank.astype(jnp.int32)[:, None] * S + jnp.arange(S)[None, :]
     prio = prio.reshape(F)
