@@ -38,7 +38,8 @@ def main():
 
     from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
     from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
-    from ue22cs343bb1_openmp_assignment_tpu.ops.step import run_cycles
+    from ue22cs343bb1_openmp_assignment_tpu.ops.step import (
+        run_chunked_to_quiescence)
 
     if args.smoke:
         args.nodes, args.trace_len, args.chunk = 64, 8, 8
@@ -49,19 +50,18 @@ def main():
     sys_ = CoherenceSystem.from_workload(
         cfg, args.workload, trace_len=args.trace_len, seed=0, **gen_kw)
 
-    # warmup: compile the chunked runner (discarded copy)
-    jax.block_until_ready(run_cycles(cfg, sys_.state, args.chunk))
+    # The whole run is ONE device dispatch (chunked scan inside a
+    # while_loop): on a high-latency device link every eager op is a
+    # network round trip, so host-side polling would dominate the
+    # measurement.
+    max_cycles = 200 * args.trace_len
 
-    # timed: run chunks until every trace is exhausted (quiescence), so
-    # the measurement covers real protocol traffic, not idle spinning.
-    state = sys_.state
+    # warmup: compile the runner (discarded copy of the full run)
+    jax.block_until_ready(
+        run_chunked_to_quiescence(cfg, sys_.state, args.chunk, max_cycles))
+
     t0 = time.perf_counter()
-    total_cycles = 0
-    while True:
-        state = run_cycles(cfg, state, args.chunk)
-        total_cycles += args.chunk
-        if bool(state.quiescent()) or total_cycles > 200 * args.trace_len:
-            break
+    state = run_chunked_to_quiescence(cfg, sys_.state, args.chunk, max_cycles)
     jax.block_until_ready(state)
     elapsed = time.perf_counter() - t0
 

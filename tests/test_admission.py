@@ -68,3 +68,28 @@ def test_parity_configs_unaffected():
     """The reference parity config never gates (admission_window=None)."""
     cfg = SystemConfig.reference()
     assert cfg.admission_window is None
+
+
+def test_chunked_quiescence_matches_exact_fixpoint():
+    """run_chunked_to_quiescence (one-dispatch bench runner) may overshoot
+    quiescence by up to chunk-1 cycles; a quiescent state is a fixpoint of
+    `cycle` apart from the cycle counters, so the final state and all
+    non-cycle metrics must equal the exact per-cycle runner's."""
+    from ue22cs343bb1_openmp_assignment_tpu.ops.step import (
+        run_chunked_to_quiescence)
+    from tests.test_native_differential import FIELDS
+
+    sys_ = hot_spot_system(admission=1, num_nodes=16, queue_capacity=16)
+    exact = run_to_quiescence(sys_.cfg, sys_.state, 50_000)
+    chunked = run_chunked_to_quiescence(sys_.cfg, sys_.state, 7, 50_000)
+    assert bool(exact.quiescent()) and bool(chunked.quiescent())
+    for f in FIELDS:
+        assert np.array_equal(np.asarray(getattr(exact, f)),
+                              np.asarray(getattr(chunked, f))), f
+    me, mc = exact.metrics, chunked.metrics
+    assert int(me.instrs_retired) == int(mc.instrs_retired)
+    assert int(me.msgs_dropped) == int(mc.msgs_dropped)
+    assert np.array_equal(np.asarray(me.msgs_processed),
+                          np.asarray(mc.msgs_processed))
+    # overshoot is bounded by one chunk
+    assert int(me.cycles) <= int(mc.cycles) < int(me.cycles) + 7
