@@ -118,3 +118,30 @@ def test_forty_nodes_two_word_bitvector():
     traces = random_traces(rng, cfg, trace_len=8)
     jx_final, nat_state = run_both(cfg, traces)
     assert_state_equal(jx_final, nat_state, "40 nodes")
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_scatter_inv_mode_agrees(seed):
+    """The scale path (inv_mode='scatter': home-side invalidation, no
+    sharer payload in messages) must agree across engines too — this is
+    the semantics bench.py measures at 4096+ nodes."""
+    cfg = SystemConfig(num_nodes=32, cache_size=4, mem_size=16,
+                       queue_capacity=64, max_instrs=16,
+                       inv_mode="scatter")
+    assert cfg.msg_bitvec_words == 1
+    rng = np.random.RandomState(300 + seed)
+    traces = random_traces(rng, cfg, trace_len=12)
+    jx_final, nat_state = run_both(cfg, traces)
+    assert_state_equal(jx_final, nat_state, f"scatter seed={seed}")
+
+
+def test_scatter_inv_mode_admission_agrees():
+    """Scatter mode composed with the admission window (the bench's
+    backpressure configuration)."""
+    cfg = SystemConfig(num_nodes=48, cache_size=4, mem_size=16,
+                       queue_capacity=16, max_instrs=12,
+                       inv_mode="scatter", admission_window=4)
+    rng = np.random.RandomState(77)
+    traces = random_traces(rng, cfg, trace_len=10)
+    jx_final, nat_state = run_both(cfg, traces)
+    assert_state_equal(jx_final, nat_state, "scatter+admission")

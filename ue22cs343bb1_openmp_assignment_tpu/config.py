@@ -100,6 +100,19 @@ class SystemConfig:
         return max(1, math.ceil(self.num_nodes / 32))
 
     @property
+    def msg_bitvec_words(self) -> int:
+        """uint32 words of sharer-bitvector payload per mailbox slot.
+
+        Only REPLY_ID carries a sharer set (assignment.c:345,429), and
+        only in mailbox INV mode — in scatter mode the home applies the
+        invalidations itself when it processes the UPGRADE/WRITE_REQUEST
+        (ops/handlers.py), so messages carry no bitvector and the mailbox
+        payload shrinks to one dummy word. At 4096 nodes this is the
+        difference between a 134 MB and a 1 MB mailbox tensor.
+        """
+        return self.bitvec_words if self.inv_mode == "mailbox" else 1
+
+    @property
     def is_reference_compat(self) -> bool:
         """True when dimensions match the reference exactly (parity mode)."""
         return (self.num_nodes <= 8 and self.cache_size == 4

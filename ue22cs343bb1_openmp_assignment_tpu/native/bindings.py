@@ -51,6 +51,7 @@ def load_library() -> ctypes.CDLL:
         lib.sim_set_schedule.argtypes = [ctypes.c_void_p, i32p, i32p]
         lib.sim_set_arbitration.argtypes = [ctypes.c_void_p, i32p]
         lib.sim_set_admission.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.sim_set_inv_mode.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.sim_run.restype = ctypes.c_int64
         lib.sim_run.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.sim_quiescent.restype = ctypes.c_int32
@@ -77,6 +78,8 @@ class NativeEngine:
                                        cfg.max_instrs)
         if cfg.admission_window is not None:
             self._lib.sim_set_admission(self._h, cfg.admission_window)
+        self._lib.sim_set_inv_mode(
+            self._h, 0 if cfg.inv_mode == "mailbox" else 1)
 
     def __del__(self):
         h = getattr(self, "_h", None)

@@ -120,6 +120,11 @@ class Engine {
 
   void set_admission(int32_t window) { admission_window_ = window; }
 
+  // 0 = mailbox (reference-exact INV messages), 1 = scatter (home-side
+  // invalidation, matching ops/handlers.py scatter mode: the home applies
+  // the kill set itself at end of cycle; REPLY_ID carries no sharer set).
+  void set_inv_mode(int32_t mode) { inv_mode_ = mode; }
+
   bool quiescent() const {
     for (int32_t i = 0; i < n_; ++i) {
       if (!queues_[i].empty() || waiting_[i]) return false;
@@ -139,6 +144,7 @@ class Engine {
     // Outgoing sends are buffered per cycle, then delivered in
     // (arb_rank(sender), program order) — identical to ops/mailbox.py.
     pending_.clear();
+    pending_inv_.clear();
     // admission snapshot: outstanding requests at cycle start
     inflight_start_ = 0;
     for (uint8_t w : waiting_) inflight_start_ += w;
@@ -154,6 +160,7 @@ class Engine {
       }
     }
     deliver();
+    apply_pending_inv();
     metrics_.cycles++;
   }
 
@@ -249,6 +256,26 @@ class Engine {
         queues_[r].push_back(std::move(pending_[i].second));
       } else {
         metrics_.msgs_dropped++;  // silent drop, reference overflow rule
+      }
+    }
+  }
+
+  // Scatter-mode invalidations are buffered during the handler loop and
+  // applied against end-of-cycle state — the JAX engine computes the kill
+  // mask on the post-update cache arrays (ops/step.py), and both engines
+  // must see the same tags. The reference tracks no INV-acks
+  // (assignment.c:358-361), so no reply traffic is owed.
+  void apply_pending_inv() {
+    for (const auto& p : pending_inv_) {
+      const int32_t addr = p.first;
+      const BitVec& bv = p.second;
+      const int32_t line = cline_of(addr);
+      for (int32_t t = 0; t < n_; ++t) {
+        if (!bv_test(bv, t)) continue;
+        if (ca(t, line) == addr) {
+          if (cs(t, line) != kInvalid) metrics_.invalidations++;
+          cs(t, line) = kInvalid;
+        }
       }
     }
   }
@@ -365,21 +392,27 @@ class Engine {
         out.type = kReplyId;
         out.sender = node;
         out.addr = msg.addr;
-        out.bitvec = others;
+        if (inv_mode_ == 1) {
+          pending_inv_.emplace_back(msg.addr, others);  // home-side kill
+        } else {
+          out.bitvec = others;
+        }
         send(msg.sender, out);
         dstate = kEM;
         bv_put(node, block, bv_single(msg.sender));
         break;
       }
       case kReplyId: {  // at requester (new owner)
-        for (int32_t i = 0; i < n_; ++i) {
-          if (bv_test(msg.bitvec, i)) {
-            Message inv;
-            inv.type = kInv;
-            inv.sender = node;
-            inv.addr = msg.addr;
-            inv.bitvec.assign(words_, 0);
-            send(i, inv);
+        if (inv_mode_ == 0) {  // scatter mode: home already applied INVs
+          for (int32_t i = 0; i < n_; ++i) {
+            if (bv_test(msg.bitvec, i)) {
+              Message inv;
+              inv.type = kInv;
+              inv.sender = node;
+              inv.addr = msg.addr;
+              inv.bitvec.assign(words_, 0);
+              send(i, inv);
+            }
           }
         }
         if (ca(node, line) != msg.addr && cs(node, line) != kInvalid)
@@ -409,7 +442,11 @@ class Engine {
           out.type = kReplyId;
           out.sender = node;
           out.addr = msg.addr;
-          out.bitvec = others;
+          if (inv_mode_ == 1) {
+            pending_inv_.emplace_back(msg.addr, others);  // home-side kill
+          } else {
+            out.bitvec = others;
+          }
           send(msg.sender, out);
         } else {  // EM: ask old owner to flush+invalidate
           out.type = kWritebackInv;
@@ -574,7 +611,9 @@ class Engine {
   std::vector<uint8_t> waiting_;
   std::vector<std::deque<Message>> queues_;
   std::vector<std::pair<int32_t, Message>> pending_;
+  std::vector<std::pair<int32_t, BitVec>> pending_inv_;  // (addr, targets)
   Metrics metrics_;
+  int32_t inv_mode_ = 0;           // 0 = mailbox INV, 1 = home-side scatter
   int32_t admission_window_ = -1;  // -1 = no gating (reference semantics)
   int32_t inflight_start_ = 0;
   int32_t admitted_this_cycle_ = 0;
@@ -609,6 +648,10 @@ void sim_set_arbitration(void* h, const int32_t* rank) {
 
 void sim_set_admission(void* h, int32_t window) {
   static_cast<Engine*>(h)->set_admission(window);
+}
+
+void sim_set_inv_mode(void* h, int32_t mode) {
+  static_cast<Engine*>(h)->set_inv_mode(mode);
 }
 
 int64_t sim_run(void* h, int64_t max_cycles) {

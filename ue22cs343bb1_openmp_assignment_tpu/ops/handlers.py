@@ -173,14 +173,15 @@ def message_phase(cfg: SystemConfig, state: SimState, mv: MsgView):
     # ---- candidate out-messages ------------------------------------------
     none = jnp.full((N,), int(Msg.NONE), jnp.int32)
     zero = jnp.zeros((N,), jnp.int32)
-    zbv = jnp.zeros((N, W), jnp.uint32)
+    zbv = jnp.zeros((N, cfg.msg_bitvec_words), jnp.uint32)
     others_bv = dirbv & ~sender_bit  # UPGRADE / WRITE_REQUEST@S sharer list
+    grants_em = is_upg | (is_wreq & d_s)  # handlers that answer REPLY_ID
 
     # primary send (slot 0) — each handler's first sendMessage
     pri_mask = is_rr | is_wbint | is_upg | is_wreq | is_wbinv | es_notify
     pri_type = jnp.select(
         [is_rr & d_em, is_rr, is_wbint,
-         is_upg | (is_wreq & d_s), is_wreq & d_u, is_wreq,
+         grants_em, is_wreq & d_u, is_wreq,
          is_wbinv, es_notify],
         [jnp.full((N,), int(Msg.WRITEBACK_INT), jnp.int32),
          jnp.full((N,), int(Msg.REPLY_RD), jnp.int32),
@@ -204,7 +205,14 @@ def message_phase(cfg: SystemConfig, state: SimState, mv: MsgView):
         [is_rr & d_em, is_wreq & d_em, is_wbint | is_wbinv],
         [mv.sender, mv.sender, mv.second], default=zero)
     pri_dirstate = jnp.where(is_rr & d_s, int(DirState.S), int(DirState.EM))
-    pri_bitvec = jnp.where((is_upg | (is_wreq & d_s))[:, None], others_bv, zbv)
+    if cfg.inv_mode == "mailbox":
+        # REPLY_ID carries the sharers-minus-requester set for the
+        # requester's INV fan-out (assignment.c:345,364-373).
+        pri_bitvec = jnp.where(grants_em[:, None], others_bv, zbv)
+    else:
+        # scatter mode: the home applies the invalidations itself (below),
+        # so REPLY_ID carries no payload and mailbox slots stay 1 word.
+        pri_bitvec = zbv
 
     # secondary send (slot 1): FLUSH / FLUSH_INVACK to the secondReceiver.
     # WRITEBACK_INT dedups home==requester; WRITEBACK_INV does not (quirk 3).
@@ -217,8 +225,15 @@ def message_phase(cfg: SystemConfig, state: SimState, mv: MsgView):
     sec_second = mv.second
 
     # INV fan-out (assignment.c:364-373): mailbox mode materializes one
-    # slot per potential target; scatter mode returns the payload for a
-    # dense cross-node application in the step.
+    # slot per potential target, sourced at the requester processing
+    # REPLY_ID exactly like the reference; scatter mode sources the
+    # invalidation at the *home* processing the UPGRADE/WRITE_REQUEST —
+    # the reference tracks no INV-acks (assignment.c:358-361), so the
+    # only observable difference is that INVs land 2 hops earlier, and
+    # messages need not carry sharer sets at all. A home processes at
+    # most one message per cycle, so each home has at most one broadcast
+    # in flight per cycle — which is what lets the step apply all kills
+    # with one O(N*C) gather keyed by each line's home (ops/step.py).
     if cfg.inv_mode == "mailbox":
         targets = jnp.arange(N, dtype=jnp.int32)
         tw, tb = targets // 32, (targets % 32).astype(jnp.uint32)
@@ -230,7 +245,7 @@ def message_phase(cfg: SystemConfig, state: SimState, mv: MsgView):
         inv_scatter = None
     else:
         inv_type = inv_recv = inv_addr = None
-        inv_scatter = (is_rid, mv.addr, mv.bitvec)
+        inv_scatter = (grants_em, mv.addr, others_bv)  # always at home
 
     # eviction notice (last slot) — handleCacheReplacement
     # (assignment.c:767-804): EVICT_MODIFIED carries the dirty value.

@@ -62,7 +62,7 @@ class Candidates(NamedTuple):
 
 
 def empty_candidates(cfg: SystemConfig) -> Candidates:
-    N, S, W = cfg.num_nodes, cfg.out_slots, cfg.bitvec_words
+    N, S, W = cfg.num_nodes, cfg.out_slots, cfg.msg_bitvec_words
     z = jnp.zeros((N, S), jnp.int32)
     return Candidates(type=jnp.full((N, S), int(Msg.NONE), jnp.int32),
                       recv=z, sender=z, addr=z, value=z, second=z,
@@ -193,7 +193,7 @@ def push_message(cfg: SystemConfig, state, receiver: int, *, type,
     tail = (int(state.mb_head[r]) + int(state.mb_count[r])) % cfg.queue_capacity
     if int(state.mb_count[r]) >= cfg.queue_capacity:
         return state  # silent drop, like the reference
-    W = cfg.bitvec_words
+    W = cfg.msg_bitvec_words
     bv = jnp.zeros((W,), jnp.uint32)
     bv_int = int(bitvec)
     for w in range(W):

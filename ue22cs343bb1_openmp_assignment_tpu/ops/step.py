@@ -29,6 +29,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ue22cs343bb1_openmp_assignment_tpu import codec
 from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
 from ue22cs343bb1_openmp_assignment_tpu.ops import frontend, handlers, mailbox
 from ue22cs343bb1_openmp_assignment_tpu.state import SimState
@@ -42,7 +43,7 @@ def cycle(cfg: SystemConfig, state: SimState) -> SimState:
     ``state.arb_rank`` (see ops.mailbox.deliver and state.SimState) — the
     seedable schedule knob; identity by default.
     """
-    N, W = cfg.num_nodes, cfg.bitvec_words
+    N = cfg.num_nodes
     rows = jnp.arange(N, dtype=jnp.int32)
     arb_rank = state.arb_rank
 
@@ -92,8 +93,9 @@ def cycle(cfg: SystemConfig, state: SimState) -> SimState:
 
     # ---- assemble candidates ---------------------------------------------
     S = cfg.out_slots
+    Wm = cfg.msg_bitvec_words
     zero = jnp.zeros((N,), jnp.int32)
-    zbv = jnp.zeros((N, W), jnp.uint32)
+    zbv = jnp.zeros((N, Wm), jnp.uint32)
     pt, pr, pa, pv, ps, pd, pb = m_cand["pri"]
     # slot 0 is shared: message-phase primary XOR frontend request
     rt, rr_, ra, rv = f_req
@@ -131,7 +133,7 @@ def cycle(cfg: SystemConfig, state: SimState) -> SimState:
              zero[:, None]], axis=1)
         c_bitvec = jnp.concatenate(
             [jnp.stack([s0_bitvec, zbv], axis=1),
-             jnp.zeros((N, N, W), jnp.uint32), zbv[:, None]], axis=1)
+             jnp.zeros((N, N, Wm), jnp.uint32), zbv[:, None]], axis=1)
     else:
         c_type = stack([s0_type, st_, et_])
         c_recv = stack([s0_recv, sr_, er_])
@@ -151,18 +153,21 @@ def cycle(cfg: SystemConfig, state: SimState) -> SimState:
     mb_upd, dropped = mailbox.deliver(cfg, state, cand, arb_rank,
                                       new_head, new_count)
 
-    # dense INV application (scale path; reference assumes INV never
-    # fails and tracks no acks, assignment.c:358-361)
+    # Vectorized INV application (scale path; reference assumes INV never
+    # fails and tracks no acks, assignment.c:358-361). The broadcast for
+    # address a can only originate from home(a), and a home processes at
+    # most one message per cycle, so each cached line needs exactly one
+    # lookup: did my home broadcast my tag this cycle, with my bit set?
+    # O(N*C) gathers — no cross-node product.
     inv_applied = jnp.zeros((), jnp.int32)
     if inv_scatter is not None:
-        im, ia, ibv = inv_scatter
-        # bit of target t in source s's vector: [N_src, N_tgt]
-        tw, tb = rows // 32, (rows % 32).astype(jnp.uint32)
-        bits = (ibv[:, tw] >> tb[None, :]) & 1
-        targeted = im[:, None] & (bits == 1)                 # [S, T]
-        # line c of target t dies if any source targets t with its tag
-        match = (cache_addr[None, :, :] == ia[:, None, None])  # [S, T, C]
-        kill = jnp.any(targeted[:, :, None] & match, axis=0)   # [T, C]
+        im, ia, ibv = inv_scatter                       # [N], [N], [N, W]
+        h = jnp.clip(codec.home_node(cfg, cache_addr), 0, N - 1)  # [N, C]
+        active = im[h] & (ia[h] == cache_addr)          # sentinel never matches
+        tw = jnp.broadcast_to((rows // 32)[:, None], h.shape)
+        tb = (rows % 32).astype(jnp.uint32)[:, None]
+        word = ibv[h, tw]                               # [N, C] u32
+        kill = active & (((word >> tb) & 1) == 1)
         inv_applied = jnp.sum(
             kill & (cache_state != int(CacheState.INVALID))).astype(jnp.int32)
         cache_state = jnp.where(kill, int(CacheState.INVALID), cache_state)

@@ -86,7 +86,9 @@ class SimState(struct.PyTreeNode):
     mb_value: jnp.ndarray      # [N, Q] i32
     mb_second: jnp.ndarray     # [N, Q] i32
     mb_dirstate: jnp.ndarray   # [N, Q] i32
-    mb_bitvec: jnp.ndarray     # [N, Q, W] u32 (REPLY_ID sharer payload)
+    mb_bitvec: jnp.ndarray     # [N, Q, Wm] u32 (REPLY_ID sharer payload;
+                               #   Wm = cfg.msg_bitvec_words — one dummy
+                               #   word in scatter INV mode)
     mb_head: jnp.ndarray       # [N] i32
     mb_count: jnp.ndarray      # [N] i32
 
@@ -143,6 +145,7 @@ def init_state(cfg: SystemConfig, traces=None, issue_delay=None,
     """
     N, C, M = cfg.num_nodes, cfg.cache_size, cfg.mem_size
     T, Q, W = cfg.max_instrs, cfg.queue_capacity, cfg.bitvec_words
+    Wm = cfg.msg_bitvec_words
 
     node_ids = jnp.arange(N, dtype=jnp.int32)
     mem_init = (20 * node_ids[:, None]
@@ -203,7 +206,7 @@ def init_state(cfg: SystemConfig, traces=None, issue_delay=None,
         mb_value=jnp.zeros((N, Q), jnp.int32),
         mb_second=jnp.zeros((N, Q), jnp.int32),
         mb_dirstate=jnp.zeros((N, Q), jnp.int32),
-        mb_bitvec=jnp.zeros((N, Q, W), jnp.uint32),
+        mb_bitvec=jnp.zeros((N, Q, Wm), jnp.uint32),
         mb_head=jnp.zeros((N,), jnp.int32),
         mb_count=jnp.zeros((N,), jnp.int32),
         issue_delay=jnp.asarray(issue_delay, jnp.int32),
