@@ -1,0 +1,139 @@
+"""Checkpoint/resume: bit-exact continuation (SURVEY §5 — the reference
+has no persistence; its only artifact is the end-state dump,
+``assignment.c:853-905``)."""
+
+import jax
+import numpy as np
+import pytest
+
+from tests.conftest import requires_reference
+
+pytestmark = requires_reference
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
+from ue22cs343bb1_openmp_assignment_tpu.utils import checkpoint
+
+
+def _assert_states_equal(a, b):
+    la = jax.tree_util.tree_leaves_with_path(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for (path, x), y in zip(la, lb):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=str(path))
+
+
+def test_roundtrip_identity(tmp_path):
+    cfg = SystemConfig.reference()
+    sys_ = CoherenceSystem.from_test_dir("/root/reference/tests/test_1", cfg)
+    sys_ = sys_.run_cycles(7)
+    p = str(tmp_path / "ckpt.npz")
+    sys_.save(p, meta={"note": "mid-run"})
+    cfg2, state2, meta = checkpoint.load_checkpoint(p)
+    assert cfg2 == cfg
+    assert meta["note"] == "mid-run"
+    _assert_states_equal(sys_.state, state2)
+
+
+def test_resume_matches_uninterrupted_run(tmp_path):
+    """run(k) → save → load → run-to-quiescence == straight run."""
+    cfg = SystemConfig.reference()
+    base = CoherenceSystem.from_test_dir("/root/reference/tests/test_2", cfg)
+
+    straight = base.run()
+    assert straight.quiescent
+
+    p = str(tmp_path / "mid.npz")
+    base.run_cycles(5).save(p)
+    resumed = CoherenceSystem.load(p).run()
+    assert resumed.quiescent
+
+    _assert_states_equal(straight.state, resumed.state)
+    assert straight.dumps() == resumed.dumps()
+
+
+def test_resume_scale_config(tmp_path):
+    """Checkpointing works for the scale path (scatter INV, >64 nodes)."""
+    cfg = SystemConfig.scale(num_nodes=128, queue_capacity=16)
+    sys_ = CoherenceSystem.from_workload(cfg, "uniform", trace_len=8, seed=3)
+    mid = sys_.run_cycles(4)
+    p = str(tmp_path / "scale.npz")
+    mid.save(p)
+    resumed = CoherenceSystem.load(p)
+    assert resumed.cfg == mid.cfg  # from_workload rewrote max_instrs
+    _assert_states_equal(mid.state, resumed.state)
+    a = mid.run_cycles(6)
+    b = resumed.run_cycles(6)
+    _assert_states_equal(a.state, b.state)
+
+
+def test_version_gate(tmp_path):
+    cfg = SystemConfig.reference()
+    sys_ = CoherenceSystem.from_workload(cfg, "uniform", trace_len=4)
+    p = str(tmp_path / "v.npz")
+    old = checkpoint.FORMAT_VERSION
+    try:
+        checkpoint.FORMAT_VERSION = old + 1
+        sys_.save(p)
+    finally:
+        checkpoint.FORMAT_VERSION = old
+    with pytest.raises(ValueError, match="format"):
+        checkpoint.load_checkpoint(p)
+
+
+def test_checkpoint_bytes_reports_payload():
+    cfg = SystemConfig.reference()
+    sys_ = CoherenceSystem.from_workload(cfg, "uniform", trace_len=4)
+    n = checkpoint.checkpoint_bytes(sys_.state)
+    # at least the mailbox payload alone: N*Q int32 x 6 fields
+    assert n > cfg.num_nodes * cfg.queue_capacity * 4 * 6
+
+
+def test_cli_checkpoint_resume_roundtrip(tmp_path):
+    """cache-sim test_1 --run-cycles 5 --save-checkpoint → --resume
+    reproduces the straight run's golden dumps."""
+    from ue22cs343bb1_openmp_assignment_tpu import cli
+
+    straight_dir = tmp_path / "straight"
+    resumed_dir = tmp_path / "resumed"
+    straight_dir.mkdir()
+    resumed_dir.mkdir()
+    ck = str(tmp_path / "cli.npz")
+
+    rc = cli.main(["test_1", "--tests-root", "/root/reference/tests",
+                   "--out-dir", str(straight_dir)])
+    assert rc == 0
+    rc = cli.main(["test_1", "--tests-root", "/root/reference/tests",
+                   "--run-cycles", "5", "--save-checkpoint", ck,
+                   "--out-dir", str(tmp_path)])
+    assert rc == 0
+    rc = cli.main(["--resume", ck, "--dump", "--out-dir", str(resumed_dir)])
+    assert rc == 0
+
+    for n in range(4):
+        f = f"core_{n}_output.txt"
+        assert ((straight_dir / f).read_text()
+                == (resumed_dir / f).read_text()), f
+
+
+def test_cli_resume_applies_schedule_knobs(tmp_path):
+    """--arb-seed/--delays on --resume override the checkpointed knobs."""
+    from ue22cs343bb1_openmp_assignment_tpu import cli
+    from ue22cs343bb1_openmp_assignment_tpu.utils import checkpoint as ckpt
+
+    ck = str(tmp_path / "k.npz")
+    rc = cli.main(["test_1", "--tests-root", "/root/reference/tests",
+                   "--run-cycles", "2", "--save-checkpoint", ck,
+                   "--out-dir", str(tmp_path)])
+    assert rc == 0
+    ck2 = str(tmp_path / "k2.npz")
+    rc = cli.main(["--resume", ck, "--arb-seed", "7",
+                   "--delays", "3", "0", "0", "0",
+                   "--run-cycles", "0", "--save-checkpoint", ck2,
+                   "--out-dir", str(tmp_path)])
+    assert rc == 0
+    _, st, _ = ckpt.load_checkpoint(ck2)
+    rnd = np.argsort(np.random.RandomState(7).rand(4)).astype(np.int32)
+    np.testing.assert_array_equal(np.asarray(st.arb_rank), rnd)
+    np.testing.assert_array_equal(np.asarray(st.issue_delay), [3, 0, 0, 0])

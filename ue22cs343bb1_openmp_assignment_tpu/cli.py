@@ -55,9 +55,37 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-cycles", type=int, default=100_000)
     p.add_argument("--metrics", action="store_true",
                    help="print step metrics as JSON to stderr")
+    p.add_argument("--save-checkpoint", metavar="PATH",
+                   help="write a full-state checkpoint after the run "
+                        "(resume with --resume; SURVEY §5: the reference "
+                        "has no persistence)")
+    p.add_argument("--resume", metavar="PATH",
+                   help="resume from a checkpoint instead of initializing "
+                        "(ignores workload/trace/dimension flags)")
+    p.add_argument("--run-cycles", type=int, default=None,
+                   help="run exactly this many cycles instead of running "
+                        "to quiescence (for checkpoint-then-resume runs)")
+    p.add_argument("--dump", action="store_true",
+                   help="write golden dumps even without a <test_directory>"
+                        " (e.g. after --resume)")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend (default: first device)")
     return p
+
+
+def _schedule_knobs(args, num_nodes: int) -> dict:
+    """--delays/--periods/--arb-seed → state-field overrides (one source
+    of truth for fresh runs and --resume)."""
+    kw = {}
+    if args.delays:
+        kw["issue_delay"] = np.asarray(args.delays, np.int32)
+    if args.periods:
+        kw["issue_period"] = np.asarray(args.periods, np.int32)
+    if args.arb_seed is not None:
+        kw["arb_rank"] = np.argsort(
+            np.random.RandomState(args.arb_seed).rand(num_nodes)
+        ).astype(np.int32)
+    return kw
 
 
 def main(argv=None) -> int:
@@ -76,18 +104,22 @@ def main(argv=None) -> int:
                   f"(got {len(vals)}, --nodes is {args.nodes})",
                   file=sys.stderr)
             return 2
+    init_kw = _schedule_knobs(args, args.nodes)
 
-    init_kw = {}
-    if args.delays:
-        init_kw["issue_delay"] = np.asarray(args.delays, np.int32)
-    if args.periods:
-        init_kw["issue_period"] = np.asarray(args.periods, np.int32)
-    if args.arb_seed is not None:
-        init_kw["arb_rank"] = np.argsort(
-            np.random.RandomState(args.arb_seed).rand(args.nodes)
-        ).astype(np.int32)
-
-    if args.workload:
+    if args.resume:
+        system = CoherenceSystem.load(args.resume)
+        cfg = system.cfg
+        if args.nodes != cfg.num_nodes and (args.delays or args.periods):
+            print("error: --delays/--periods with --resume need --nodes to "
+                  f"match the checkpoint ({cfg.num_nodes})", file=sys.stderr)
+            return 2
+        # schedule knobs override the checkpointed ones when given
+        overrides = _schedule_knobs(args, cfg.num_nodes)
+        if overrides:
+            import dataclasses as _dc
+            system = _dc.replace(
+                system, state=system.state.replace(**overrides))
+    elif args.workload:
         cfg = SystemConfig.scale(num_nodes=args.nodes,
                                  queue_capacity=args.queue_capacity,
                                  admission_window=args.admission)
@@ -110,8 +142,13 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
-    system = system.run(args.max_cycles)
-    if not system.quiescent:
+    if args.run_cycles is not None:
+        system = system.run_cycles(args.run_cycles)
+    else:
+        system = system.run(args.max_cycles)
+    if args.save_checkpoint:
+        system.save(args.save_checkpoint)
+    if args.run_cycles is None and not system.quiescent:
         m = system.metrics
         hint = ""
         if m["msgs_dropped"] > 0:
@@ -122,7 +159,7 @@ def main(argv=None) -> int:
         print(f"warning: not quiescent after {args.max_cycles} cycles{hint}",
               file=sys.stderr)
 
-    if args.test_dir:  # golden dumps only make sense for trace runs
+    if args.test_dir or args.dump:  # golden dumps (trace or forced)
         system.write_dumps(args.out_dir)
     if args.metrics:
         print(json.dumps(system.metrics), file=sys.stderr)

@@ -20,7 +20,7 @@ from ue22cs343bb1_openmp_assignment_tpu.models import workloads
 from ue22cs343bb1_openmp_assignment_tpu.ops.step import (cycle, run_cycles,
                                                          run_to_quiescence)
 from ue22cs343bb1_openmp_assignment_tpu.state import SimState, init_state
-from ue22cs343bb1_openmp_assignment_tpu.utils import golden, trace
+from ue22cs343bb1_openmp_assignment_tpu.utils import checkpoint, golden, trace
 
 
 @dataclasses.dataclass
@@ -102,3 +102,14 @@ class CoherenceSystem:
     @property
     def instrs_retired(self) -> int:
         return int(self.state.metrics.instrs_retired)
+
+    # -- persistence (SURVEY §5: reference has none) ----------------------
+    def save(self, path: str, meta: Optional[dict] = None) -> None:
+        """Checkpoint the whole machine at the current cycle boundary."""
+        checkpoint.save_checkpoint(path, self.cfg, self.state, meta)
+
+    @classmethod
+    def load(cls, path: str) -> "CoherenceSystem":
+        """Resume from a checkpoint; bit-exact continuation."""
+        cfg, state, _ = checkpoint.load_checkpoint(path)
+        return cls(cfg, state)

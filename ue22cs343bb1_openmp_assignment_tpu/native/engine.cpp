@@ -403,7 +403,9 @@ class Engine {
         break;
       }
       case kReplyId: {  // at requester (new owner)
-        if (inv_mode_ == 0) {  // scatter mode: home already applied INVs
+        // mailbox mode only — in scatter mode (1) the home already
+        // applied the INVs when it processed the UPGRADE/WRITE_REQUEST
+        if (inv_mode_ == 0) {
           for (int32_t i = 0; i < n_; ++i) {
             if (bv_test(msg.bitvec, i)) {
               Message inv;
