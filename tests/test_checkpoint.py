@@ -8,8 +8,6 @@ import pytest
 
 from tests.conftest import requires_reference
 
-pytestmark = requires_reference
-
 from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
 from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
 from ue22cs343bb1_openmp_assignment_tpu.utils import checkpoint
@@ -24,6 +22,7 @@ def _assert_states_equal(a, b):
             np.asarray(x), np.asarray(y), err_msg=str(path))
 
 
+@requires_reference
 def test_roundtrip_identity(tmp_path):
     cfg = SystemConfig.reference()
     sys_ = CoherenceSystem.from_test_dir("/root/reference/tests/test_1", cfg)
@@ -36,6 +35,7 @@ def test_roundtrip_identity(tmp_path):
     _assert_states_equal(sys_.state, state2)
 
 
+@requires_reference
 def test_resume_matches_uninterrupted_run(tmp_path):
     """run(k) → save → load → run-to-quiescence == straight run."""
     cfg = SystemConfig.reference()
@@ -90,6 +90,7 @@ def test_checkpoint_bytes_reports_payload():
     assert n > cfg.num_nodes * cfg.queue_capacity * 4 * 6
 
 
+@requires_reference
 def test_cli_checkpoint_resume_roundtrip(tmp_path):
     """cache-sim test_1 --run-cycles 5 --save-checkpoint → --resume
     reproduces the straight run's golden dumps."""
@@ -117,6 +118,7 @@ def test_cli_checkpoint_resume_roundtrip(tmp_path):
                 == (resumed_dir / f).read_text()), f
 
 
+@requires_reference
 def test_cli_resume_applies_schedule_knobs(tmp_path):
     """--arb-seed/--delays on --resume override the checkpointed knobs."""
     from ue22cs343bb1_openmp_assignment_tpu import cli

@@ -68,6 +68,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dump", action="store_true",
                    help="write golden dumps even without a <test_directory>"
                         " (e.g. after --resume)")
+    p.add_argument("--check", action="store_true",
+                   help="verify engine invariants after the run and report "
+                        "coherence diagnostics (the reference's -DDEBUG "
+                        "asserts, whole-machine and vectorized; exit 3 on "
+                        "violation)")
+    p.add_argument("--check-strict", action="store_true",
+                   help="like --check but also fail on coherence-tier "
+                        "violations (only meaningful for race-free "
+                        "schedules; racy workloads can legally leave "
+                        "stale copies — the protocol acks no INVs)")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend (default: first device)")
     return p
@@ -104,7 +114,6 @@ def main(argv=None) -> int:
                   f"(got {len(vals)}, --nodes is {args.nodes})",
                   file=sys.stderr)
             return 2
-    init_kw = _schedule_knobs(args, args.nodes)
 
     if args.resume:
         system = CoherenceSystem.load(args.resume)
@@ -125,8 +134,9 @@ def main(argv=None) -> int:
                                  admission_window=args.admission)
         system = CoherenceSystem.from_workload(
             cfg, args.workload, trace_len=args.trace_len, seed=args.seed,
-            init_kw=init_kw)
+            init_kw=_schedule_knobs(args, args.nodes))
     elif args.test_dir:
+        init_kw = _schedule_knobs(args, args.nodes)
         cfg = SystemConfig.reference(num_nodes=args.nodes,
                                      admission_window=args.admission)
         path = os.path.join(args.tests_root, args.test_dir)
@@ -159,6 +169,24 @@ def main(argv=None) -> int:
         print(f"warning: not quiescent after {args.max_cycles} cycles{hint}",
               file=sys.stderr)
 
+    if args.check or args.check_strict:
+        try:
+            report = system.check_invariants(
+                strict_coherence=args.check_strict)
+        except AssertionError as e:
+            print(f"invariant check FAILED: {e}", file=sys.stderr)
+            return 3
+        if not system.quiescent:
+            # the coherence tier is only defined at quiescence
+            if args.check_strict:
+                print("invariant check FAILED: machine not quiescent — "
+                      "coherence tier not checkable", file=sys.stderr)
+                return 3
+            print("invariant check passed (engine tier only; not "
+                  "quiescent, coherence tier skipped)", file=sys.stderr)
+        else:
+            print(f"invariant check passed; coherence report: "
+                  f"{json.dumps(report)}", file=sys.stderr)
     if args.test_dir or args.dump:  # golden dumps (trace or forced)
         system.write_dumps(args.out_dir)
     if args.metrics:

@@ -103,6 +103,39 @@ class CoherenceSystem:
     def instrs_retired(self) -> int:
         return int(self.state.metrics.instrs_retired)
 
+    # -- invariant checking (SURVEY §5: the TPU-way -DDEBUG build) --------
+    def check_invariants(self, strict_coherence: bool = True) -> dict:
+        """Engine-integrity invariants always assert; the cross-node
+        coherence tier asserts when ``strict_coherence`` (correct for
+        race-free schedules) and is returned as a report otherwise
+        (racy workloads can legally leave stale copies — the protocol
+        tracks no INV-acks, assignment.c:358-361; see ops.invariants).
+
+        Returns the coherence-tier counts when quiescent, else {}.
+        """
+        from ue22cs343bb1_openmp_assignment_tpu.ops import invariants
+        invariants.assert_invariants(self.cfg, self.state, quiescent=False)
+        if not self.quiescent:
+            return {}
+        report = invariants.coherence_report(self.cfg, self.state)
+        if strict_coherence and any(report.values()):
+            raise AssertionError(
+                f"coherence invariants violated: "
+                f"{ {k: v for k, v in report.items() if v} }")
+        return report
+
+    def run_checked(self, num_cycles: int) -> "CoherenceSystem":
+        """Advance with per-cycle invariant accumulation; raises on any
+        violation (one device dispatch for the whole scan)."""
+        from ue22cs343bb1_openmp_assignment_tpu.ops import invariants
+        state, acc = invariants.run_cycles_checked(self.cfg, self.state,
+                                                   num_cycles)
+        bad = {k: int(v) for k, v in acc.items() if int(v)}
+        if bad:
+            raise AssertionError(
+                f"protocol invariants violated during run: {bad}")
+        return dataclasses.replace(self, state=state)
+
     # -- persistence (SURVEY §5: reference has none) ----------------------
     def save(self, path: str, meta: Optional[dict] = None) -> None:
         """Checkpoint the whole machine at the current cycle boundary."""

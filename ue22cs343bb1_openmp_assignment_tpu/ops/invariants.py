@@ -1,0 +1,202 @@
+"""Protocol-invariant checking — the framework's "race detector".
+
+The reference's only safety net is three asserts compiled in under
+``-DDEBUG`` (sole-owner popcount on write-miss-EM ``assignment.c:449``,
+SHARED-state on S→E promotion ``assignment.c:556``, sole-owner on
+EVICT_MODIFIED ``assignment.c:608-614``); data races themselves are
+tolerated by design (SURVEY §5 "race detection: none", quirk 5). The
+vectorized engine is deterministic, so race detection becomes *protocol
+invariant checking*: whole-machine predicates evaluated on-device every
+cycle (cheap reductions) or at quiescence (cross-node coherence).
+
+Two tiers:
+
+* :func:`step_violations` — invariants that hold after **every** cycle,
+  even mid-transaction (directory/bitvector consistency, state-range,
+  ring-occupancy sanity). Violations here mean the engine itself is
+  broken.
+* :func:`quiescent_violations` — the full single-writer / coherence
+  contract, valid only once traffic has drained (while a transaction is
+  in flight the reference deliberately lets cache and directory disagree
+  — e.g. the directory moves to EM before the old owner has processed
+  WRITEBACK_INV, ``assignment.c:455-457``, quirk 4).
+
+**The coherence tier is a diagnostic, not an engine assert, under racy
+workloads.** The reference's protocol deliberately tracks no INV-acks
+(``assignment.c:358-361``): an INV that races an in-flight fill can be
+processed before the REPLY_RD it should kill arrives (tag mismatch →
+no-op, ``assignment.c:389-399``), after which the fill installs a copy
+the directory no longer knows about. Both orderings are legal reference
+behavior (they are exactly the kind of divergence behind the accepted
+``run_*`` variants, SURVEY §4); the scatter-INV scale path
+deterministically realizes the INV-first ordering. For race-free
+workloads (disjoint footprints like tests/test_1–2, or writers
+serialized via issue_delay) the coherence tier must be exactly zero —
+that is the engine-correctness claim tests/test_invariants.py pins.
+
+Everything returns a ``{name: violation_count}`` dict of device scalars,
+so checks compose with `jit`/`scan` (no host sync until you ask).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ue22cs343bb1_openmp_assignment_tpu import codec
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.state import (SimState, bit_get,
+                                                      popcount)
+from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState
+
+
+def _count(pred) -> jnp.ndarray:
+    return jnp.sum(pred).astype(jnp.int32)
+
+
+def step_violations(cfg: SystemConfig, state: SimState) -> dict:
+    """Invariants that must hold after every cycle.
+
+    The directory-side trio mirrors what the reference maintains
+    atomically inside each handler (it never leaves a handler with EM
+    and ≠1 sharer bits: ``assignment.c:228-231,346-348,455-457,
+    570-583,615-616``).
+    """
+    pc = popcount(state.dir_bitvec)                       # [N, M]
+    is_em = state.dir_state == int(DirState.EM)
+    is_s = state.dir_state == int(DirState.S)
+    is_u = state.dir_state == int(DirState.U)
+
+    return {
+        # directory ⟷ sharer-bitvector consistency
+        "em_not_single_owner": _count(is_em & (pc != 1)),
+        "shared_without_sharers": _count(is_s & (pc < 1)),
+        "unowned_with_sharers": _count(is_u & (pc != 0)),
+        # enum ranges (a scatter writing garbage shows up here first)
+        "dir_state_out_of_range": _count(
+            (state.dir_state < 0) | (state.dir_state > int(DirState.U))),
+        "cache_state_out_of_range": _count(
+            (state.cache_state < 0)
+            | (state.cache_state > int(CacheState.INVALID))),
+        # ring occupancy within capacity, head within ring
+        "mailbox_count_oob": _count(
+            (state.mb_count < 0) | (state.mb_count > cfg.queue_capacity)),
+        "mailbox_head_oob": _count(
+            (state.mb_head < 0) | (state.mb_head >= cfg.queue_capacity)),
+        # a node past its trace end must not be mid-request
+        "waiting_past_trace_end": _count(
+            state.waiting & (state.instr_idx >= state.instr_count)),
+        # byte-valued payloads stay bytes (values are &0xFF at load,
+        # assignment.c:840-845; a handler that forgets the mask drifts)
+        "memory_not_byte": _count(
+            (state.memory < 0) | (state.memory > 0xFF)),
+    }
+
+
+def quiescent_violations(cfg: SystemConfig, state: SimState) -> dict:
+    """The full coherence contract, valid once quiescent().
+
+    Cross-checks every cached line against its home directory — the
+    single-writer property the whole DASH/MESI protocol exists to
+    enforce (``README.md:14-23``):
+
+    * a valid line's bit is set in its home directory entry,
+    * MODIFIED/EXCLUSIVE lines coincide with directory EM,
+    * a block has at most one M/E copy system-wide, and no other valid
+      copies besides it,
+    * clean lines (E, S) agree with home memory (S lines were written
+      back via FLUSH before demotion, ``assignment.c:301-308``).
+    """
+    N, C, M = cfg.num_nodes, cfg.cache_size, cfg.mem_size
+    rows = jnp.arange(N, dtype=jnp.int32)[:, None]        # [N, 1]
+
+    valid = state.cache_state != int(CacheState.INVALID)  # [N, C]
+    h = jnp.clip(codec.home_node(cfg, state.cache_addr), 0, N - 1)
+    b = jnp.clip(codec.block_index(cfg, state.cache_addr), 0, M - 1)
+
+    dstate = state.dir_state[h, b]                        # [N, C]
+    dbv = state.dir_bitvec[h, b]                          # [N, C, W]
+    my_bit = bit_get(dbv, jnp.broadcast_to(rows, (N, C)))
+
+    is_m = state.cache_state == int(CacheState.MODIFIED)
+    is_e = state.cache_state == int(CacheState.EXCLUSIVE)
+    is_s = state.cache_state == int(CacheState.SHARED)
+
+    # owned-copy count per home block: scatter-add of M/E lines
+    owners = jnp.zeros((N, M), jnp.int32).at[h, b].add(
+        (is_m | is_e).astype(jnp.int32))
+    copies = jnp.zeros((N, M), jnp.int32).at[h, b].add(
+        valid.astype(jnp.int32))
+    mem_val = state.memory[h, b]
+
+    return {
+        "valid_line_unknown_to_home": _count(valid & ~my_bit),
+        "exclusive_line_dir_not_em": _count(
+            (is_m | is_e) & (dstate != int(DirState.EM))),
+        "shared_line_dir_unowned": _count(
+            is_s & (dstate == int(DirState.U))),
+        "multiple_owners": _count(owners > 1),
+        "owner_with_other_copies": _count((owners == 1) & (copies > 1)),
+        "clean_line_stale_value": _count(
+            (is_e | is_s) & (state.cache_val != mem_val)),
+        # every directory sharer bit corresponds to a real cached copy:
+        # popcount over the directory == scatter-count of valid lines
+        # pointing at it (no phantom sharers at quiescence)
+        "phantom_sharers": _count(popcount(state.dir_bitvec) != copies),
+    }
+
+
+def all_violations(cfg: SystemConfig, state: SimState,
+                   quiescent: bool = False) -> dict:
+    out = step_violations(cfg, state)
+    if quiescent:
+        out.update(quiescent_violations(cfg, state))
+    return out
+
+
+def assert_invariants(cfg: SystemConfig, state: SimState,
+                      quiescent: bool = False) -> None:
+    """Host-side check; raises AssertionError naming every violated
+    invariant with its count.
+
+    ``quiescent=True`` additionally asserts the coherence tier — only
+    meaningful for race-free schedules (see module docstring); use
+    :func:`coherence_report` for racy workloads.
+    """
+    v = {k: int(n) for k, n in all_violations(cfg, state, quiescent).items()}
+    bad = {k: n for k, n in v.items() if n}
+    if bad:
+        raise AssertionError(f"protocol invariants violated: {bad}")
+
+
+def coherence_report(cfg: SystemConfig, state: SimState) -> dict:
+    """Coherence-tier counts as plain ints — the racy-workload
+    diagnostic surface (stale copies left by the protocol's unacked-INV
+    design show up here, e.g. ``valid_line_unknown_to_home``)."""
+    return {k: int(v)
+            for k, v in quiescent_violations(cfg, state).items()}
+
+
+def run_cycles_checked(cfg: SystemConfig, state: SimState,
+                       num_cycles: int):
+    """Scan `num_cycles` cycles, accumulating per-cycle violation counts.
+
+    Returns (final_state, {name: total_count}) — one device dispatch;
+    the per-step tier is cheap reductions, so this is the always-on
+    debug runner (the reference's -DDEBUG build, done the TPU way).
+    """
+    import jax
+
+    from ue22cs343bb1_openmp_assignment_tpu.ops.step import cycle
+
+    def body(carry, _):
+        s, acc = carry
+        s = cycle(cfg, s)
+        v = step_violations(cfg, s)
+        acc = {k: acc[k] + v[k] for k in acc}
+        return (s, acc), None
+
+    zero = {k: jnp.zeros((), jnp.int32)
+            for k in step_violations(cfg, state)}
+    (state, acc), _ = jax.lax.scan(body, (state, zero), None,
+                                   length=num_cycles)
+    return state, acc

@@ -78,6 +78,10 @@ def load_checkpoint(path: str) -> Tuple[SystemConfig, SimState, dict]:
     """
     with np.load(path) as z:
         arrays = {k: z[k] for k in z.files}
+    if _CONFIG_KEY not in arrays or _META_KEY not in arrays:
+        raise ValueError(
+            f"{path} is not a checkpoint written by save_checkpoint "
+            f"(missing {_CONFIG_KEY}/{_META_KEY})")
     cfg_d = json.loads(bytes(arrays.pop(_CONFIG_KEY).tobytes()).decode())
     meta = json.loads(bytes(arrays.pop(_META_KEY).tobytes()).decode())
     if meta.get("format_version") != FORMAT_VERSION:
