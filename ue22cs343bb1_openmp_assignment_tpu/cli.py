@@ -78,6 +78,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "violations (only meaningful for race-free "
                         "schedules; racy workloads can legally leave "
                         "stale copies — the protocol acks no INVs)")
+    p.add_argument("--trace-log", metavar="PATH",
+                   help="write an instruction_order.txt-format event log "
+                        "of the run (the reference's -DDEBUG_INSTR "
+                        "tracing, assignment.c:649-652)")
+    p.add_argument("--trace-msgs", action="store_true",
+                   help="include message-dequeue events in --trace-log "
+                        "(the reference's -DDEBUG_MSG, "
+                        "assignment.c:179-182)")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend (default: first device)")
     return p
@@ -152,7 +160,18 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
-    if args.run_cycles is not None:
+    if args.trace_log:
+        from ue22cs343bb1_openmp_assignment_tpu.utils import eventlog
+        if args.run_cycles is not None:
+            system, events = system.run_cycles_traced(args.run_cycles)
+        else:
+            system, events = system.run_traced(args.max_cycles)
+        kinds = ("instr", "msg") if args.trace_msgs else ("instr",)
+        if events:
+            eventlog.write_log(args.trace_log, events, kinds)
+        else:
+            open(args.trace_log, "w").close()
+    elif args.run_cycles is not None:
         system = system.run_cycles(args.run_cycles)
     else:
         system = system.run(args.max_cycles)

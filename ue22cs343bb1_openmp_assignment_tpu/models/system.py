@@ -78,6 +78,45 @@ class CoherenceSystem:
         return dataclasses.replace(self, state=run_cycles(self.cfg,
                                                           self.state, n))
 
+    def run_cycles_traced(self, n: int):
+        """run_cycles + the structured event record; returns
+        (system, events) with events a dict of [n, N] host arrays."""
+        import numpy as np
+
+        from ue22cs343bb1_openmp_assignment_tpu.ops import step
+        state, ev = step.run_cycles_traced(self.cfg, self.state, n)
+        return (dataclasses.replace(self, state=state),
+                {k: np.asarray(v) for k, v in ev.items()})
+
+    def run_traced(self, max_cycles: int = 100_000, chunk: int = 64):
+        """Run to quiescence collecting the structured event log.
+
+        Returns (system, events) where events is a dict of
+        [cycles, N] host arrays (see ops.step.run_cycles_traced /
+        utils.eventlog) — the reference's -DDEBUG_INSTR/-DDEBUG_MSG
+        tracing as data instead of interleaved printf.
+
+        ``max_cycles`` is an absolute cap on ``state.cycle``, matching
+        run(); the final chunk is trimmed so the cap is exact. Like
+        run_chunked_to_quiescence, the run may overshoot *quiescence*
+        by up to chunk-1 cycles — a quiescent state is a fixpoint, so
+        only the cycle counters advance and the overshoot cycles
+        contribute no events.
+        """
+        import numpy as np
+
+        from ue22cs343bb1_openmp_assignment_tpu.ops import step
+        state = self.state
+        chunks = []
+        while (not bool(state.quiescent())
+               and int(state.cycle) < max_cycles):
+            n = min(chunk, max_cycles - int(state.cycle))
+            state, ev = step.run_cycles_traced(self.cfg, state, n)
+            chunks.append({k: np.asarray(v) for k, v in ev.items()})
+        events = ({k: np.concatenate([c[k] for c in chunks])
+                   for k in chunks[0]} if chunks else {})
+        return dataclasses.replace(self, state=state), events
+
     # -- observability -----------------------------------------------------
     @property
     def quiescent(self) -> bool:

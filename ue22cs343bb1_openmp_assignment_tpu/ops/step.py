@@ -36,12 +36,19 @@ from ue22cs343bb1_openmp_assignment_tpu.state import SimState
 from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, Msg
 
 
-def cycle(cfg: SystemConfig, state: SimState) -> SimState:
+def cycle(cfg: SystemConfig, state: SimState,
+          with_events: bool = False):
     """Advance the whole machine by one cycle.
 
     Cross-sender arbitration order for this cycle's deliveries comes from
     ``state.arb_rank`` (see ops.mailbox.deliver and state.SimState) — the
     seedable schedule knob; identity by default.
+
+    ``with_events=True`` additionally returns this cycle's event record
+    (per-node instruction fetches and message dequeues — the data behind
+    the reference's ``DEBUG_INSTR``/``DEBUG_MSG`` printf tracing,
+    ``assignment.c:649-652,179-182``) as a dict of [N] arrays; the
+    return becomes ``(state, events)``. The default path pays nothing.
     """
     N = cfg.num_nodes
     rows = jnp.arange(N, dtype=jnp.int32)
@@ -191,15 +198,42 @@ def cycle(cfg: SystemConfig, state: SimState) -> SimState:
         evictions=mt.evictions + m_stats["evictions"],
     )
 
-    return state.replace(
+    new_state = state.replace(
         cache_addr=cache_addr, cache_val=cache_val, cache_state=cache_state,
         memory=memory, dir_state=dir_state, dir_bitvec=dir_bitvec,
         instr_idx=f_upd["new_idx"],
         cur_op=cur_op, cur_addr=cur_addr, cur_val=cur_val, waiting=waiting,
         cycle=state.cycle + 1, metrics=metrics, **mb_upd)
+    if not with_events:
+        return new_state
+    events = {
+        # instruction fetch (assignment.c:649-652)
+        "fetch": fetch, "op": l_op, "addr": l_addr, "value": l_val,
+        # message dequeue (assignment.c:179-182)
+        "msg": mv.has_msg, "msg_sender": mv.sender,
+        "msg_type": mv.type, "msg_addr": mv.addr,
+    }
+    return new_state, events
 
 
 # -- runners ---------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def run_cycles_traced(cfg: SystemConfig, state: SimState,
+                      num_cycles: int):
+    """Scan `num_cycles` cycles collecting the per-cycle event record.
+
+    Returns (state, events) with events a dict of [num_cycles, N]
+    arrays — the structured replacement for the reference's printf
+    tracing (utils.eventlog formats them into the exact
+    ``instruction_order.txt`` line format).
+    """
+
+    def body(s, _):
+        return cycle(cfg, s, with_events=True)
+
+    return jax.lax.scan(body, state, None, length=num_cycles)
+
 
 @functools.partial(jax.jit, static_argnums=(0, 2))
 def run_cycles(cfg: SystemConfig, state: SimState,
