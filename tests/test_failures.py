@@ -1,0 +1,129 @@
+"""Fault injection + stall watchdog (SURVEY §5: the reference's only
+fault is a silent overflow drop, and a stranded node spins forever with
+no detection, assignment.c:754-762,624-629)."""
+
+import numpy as np
+import pytest
+
+from tests.conftest import REFERENCE_TESTS, requires_reference
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
+from ue22cs343bb1_openmp_assignment_tpu.ops import failures
+
+
+def _cross_node_system(drop_prob, fault_seed=0, nodes=16):
+    cfg = SystemConfig.scale(num_nodes=nodes, queue_capacity=32,
+                             drop_prob=drop_prob)
+    return CoherenceSystem.from_workload(
+        cfg, "uniform", trace_len=6, seed=4,
+        init_kw={"fault_seed": fault_seed}, local_frac=0.0)
+
+
+def test_full_drop_strands_and_watchdog_detects():
+    """drop_prob=1.0: every request dies in flight; requesters stall and
+    the watchdog names them with the stuck request."""
+    sys_ = _cross_node_system(1.0).run(max_cycles=300)
+    assert not sys_.quiescent
+    m = sys_.metrics
+    assert m["msgs_injected_dropped"] > 0
+    stalled = sys_.stalled(threshold=50)
+    assert stalled, "watchdog missed stranded nodes"
+    assert {"node", "since_cycle", "op", "addr"} <= set(stalled[0])
+    # every stalled node really is waiting
+    waiting = np.asarray(sys_.state.waiting)
+    assert all(waiting[s["node"]] for s in stalled)
+
+
+def test_injection_is_seed_deterministic():
+    a = _cross_node_system(0.3, fault_seed=7).run(max_cycles=200)
+    b = _cross_node_system(0.3, fault_seed=7).run(max_cycles=200)
+    assert a.metrics == b.metrics
+    np.testing.assert_array_equal(np.asarray(a.state.cache_val),
+                                  np.asarray(b.state.cache_val))
+    c = _cross_node_system(0.3, fault_seed=8).run(max_cycles=200)
+    assert (c.metrics["msgs_injected_dropped"]
+            != a.metrics["msgs_injected_dropped"]
+            or c.metrics["cycles"] != a.metrics["cycles"])
+
+
+def test_healthy_run_reports_no_stalls():
+    sys_ = _cross_node_system(0.0).run()
+    assert sys_.quiescent
+    assert sys_.stalled(threshold=50) == []
+    # waiting_since resets to -1 once unblocked
+    assert (np.asarray(sys_.state.waiting_since) == -1).all()
+    assert sys_.metrics["msgs_injected_dropped"] == 0
+
+
+@requires_reference
+def test_zero_drop_prob_is_bitfree():
+    """drop_prob=0 pays nothing and changes nothing: golden parity."""
+    cfg = SystemConfig.reference(drop_prob=0.0)
+    sys_ = CoherenceSystem.from_test_dir(
+        f"{REFERENCE_TESTS}/test_1", cfg).run()
+    import os
+    for n in range(4):
+        with open(os.path.join(REFERENCE_TESTS, "test_1",
+                               f"core_{n}_output.txt")) as f:
+            assert sys_.dumps()[n] == f.read()
+
+
+def test_watchdog_threshold_respected():
+    sys_ = _cross_node_system(1.0).run(max_cycles=60)
+    assert sys_.stalled(threshold=10_000) == []
+
+
+@requires_reference
+def test_cli_drop_prob_watchdog(tmp_path, capsys):
+    from ue22cs343bb1_openmp_assignment_tpu import cli
+    rc = cli.main(["test_3", "--tests-root", REFERENCE_TESTS,
+                   "--out-dir", str(tmp_path),
+                   "--drop-prob", "1.0", "--max-cycles", "300",
+                   "--stall-threshold", "50", "--metrics"])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "fault injection" in err
+    assert "watchdog" in err
+
+
+def test_cli_resume_overrides_behavior_knobs(tmp_path):
+    """--admission/--drop-prob on --resume override the checkpointed
+    config (the watchdog's recommended recovery path), while a changed
+    --queue-capacity is rejected (shape-determining)."""
+    from ue22cs343bb1_openmp_assignment_tpu import cli
+    from ue22cs343bb1_openmp_assignment_tpu.utils import checkpoint as ckpt
+
+    ck = str(tmp_path / "r.npz")
+    rc = cli.main(["--workload", "uniform", "--nodes", "8",
+                   "--queue-capacity", "16", "--drop-prob", "1.0",
+                   "--run-cycles", "10", "--save-checkpoint", ck,
+                   "--out-dir", str(tmp_path)])
+    assert rc == 0
+    cfg0, _, _ = ckpt.load_checkpoint(ck)
+    assert cfg0.drop_prob == 1.0
+
+    ck2 = str(tmp_path / "r2.npz")
+    rc = cli.main(["--resume", ck, "--drop-prob", "0", "--admission", "2",
+                   "--run-cycles", "0", "--save-checkpoint", ck2,
+                   "--out-dir", str(tmp_path)])
+    assert rc == 0
+    cfg1, _, _ = ckpt.load_checkpoint(ck2)
+    assert cfg1.drop_prob == 0.0 and cfg1.admission_window == 2
+
+    rc = cli.main(["--resume", ck, "--queue-capacity", "32",
+                   "--out-dir", str(tmp_path)])
+    assert rc == 2
+
+
+def test_checkpoint_roundtrip_with_faults(tmp_path):
+    """fault_key and waiting_since survive checkpoint/resume so the
+    injected drop sequence continues identically."""
+    mid = _cross_node_system(0.3, fault_seed=7).run_cycles(20)
+    p = str(tmp_path / "f.npz")
+    mid.save(p)
+    resumed = CoherenceSystem.load(p).run_cycles(30)
+    straight = mid.run_cycles(30)
+    np.testing.assert_array_equal(
+        np.asarray(straight.state.fault_key),
+        np.asarray(resumed.state.fault_key))
+    assert straight.metrics == resumed.metrics

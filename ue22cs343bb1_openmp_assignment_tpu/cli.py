@@ -37,7 +37,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run a synthetic workload instead of trace files")
     p.add_argument("--nodes", type=int, default=4)
     p.add_argument("--trace-len", type=int, default=32)
-    p.add_argument("--queue-capacity", type=int, default=256)
+    p.add_argument("--queue-capacity", type=int, default=None,
+                   help="mailbox slots per node (default 256; shape-"
+                        "determining, so it cannot change on --resume)")
     p.add_argument("--seed", type=int, default=0,
                    help="workload PRNG seed")
     p.add_argument("--delays", type=int, nargs="*",
@@ -78,6 +80,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "violations (only meaningful for race-free "
                         "schedules; racy workloads can legally leave "
                         "stale copies — the protocol acks no INVs)")
+    p.add_argument("--drop-prob", type=float, default=None,
+                   help="fault injection: drop each delivered message "
+                        "with this probability (stress for the stall "
+                        "watchdog; reference's only fault is the silent "
+                        "overflow drop; default 0 = off)")
+    p.add_argument("--fault-seed", type=int, default=0,
+                   help="PRNG seed for --drop-prob injection")
+    p.add_argument("--stall-threshold", type=int, default=100,
+                   help="cycles a node may wait on one request before "
+                        "the watchdog reports it stalled")
     p.add_argument("--trace-log", metavar="PATH",
                    help="write an instruction_order.txt-format event log "
                         "of the run (the reference's -DDEBUG_INSTR "
@@ -124,29 +136,50 @@ def main(argv=None) -> int:
             return 2
 
     if args.resume:
+        import dataclasses as _dc
         system = CoherenceSystem.load(args.resume)
         cfg = system.cfg
         if args.nodes != cfg.num_nodes and (args.delays or args.periods):
             print("error: --delays/--periods with --resume need --nodes to "
                   f"match the checkpoint ({cfg.num_nodes})", file=sys.stderr)
             return 2
+        if (args.queue_capacity is not None
+                and args.queue_capacity != cfg.queue_capacity):
+            print("error: --queue-capacity is shape-determining and cannot "
+                  f"change on --resume (checkpoint has "
+                  f"{cfg.queue_capacity})", file=sys.stderr)
+            return 2
+        # behavior knobs (shape-free) override the checkpointed config —
+        # this is the watchdog's recommended recovery path
+        cfg_over = {}
+        if args.admission is not None:
+            cfg_over["admission_window"] = args.admission
+        if args.drop_prob is not None:
+            cfg_over["drop_prob"] = args.drop_prob
+        if cfg_over:
+            cfg = _dc.replace(cfg, **cfg_over)
+            system = _dc.replace(system, cfg=cfg)
         # schedule knobs override the checkpointed ones when given
         overrides = _schedule_knobs(args, cfg.num_nodes)
         if overrides:
-            import dataclasses as _dc
             system = _dc.replace(
                 system, state=system.state.replace(**overrides))
     elif args.workload:
         cfg = SystemConfig.scale(num_nodes=args.nodes,
-                                 queue_capacity=args.queue_capacity,
-                                 admission_window=args.admission)
+                                 queue_capacity=args.queue_capacity or 256,
+                                 admission_window=args.admission,
+                                 drop_prob=args.drop_prob or 0.0)
+        init_kw = _schedule_knobs(args, args.nodes)
+        init_kw["fault_seed"] = args.fault_seed
         system = CoherenceSystem.from_workload(
             cfg, args.workload, trace_len=args.trace_len, seed=args.seed,
-            init_kw=_schedule_knobs(args, args.nodes))
+            init_kw=init_kw)
     elif args.test_dir:
         init_kw = _schedule_knobs(args, args.nodes)
+        init_kw["fault_seed"] = args.fault_seed
         cfg = SystemConfig.reference(num_nodes=args.nodes,
-                                     admission_window=args.admission)
+                                     admission_window=args.admission,
+                                     drop_prob=args.drop_prob or 0.0)
         path = os.path.join(args.tests_root, args.test_dir)
         try:
             system = CoherenceSystem.from_test_dir(path, cfg, **init_kw)
@@ -162,13 +195,15 @@ def main(argv=None) -> int:
 
     if args.trace_log:
         from ue22cs343bb1_openmp_assignment_tpu.utils import eventlog
+        trace_base = int(system.state.cycle)
         if args.run_cycles is not None:
             system, events = system.run_cycles_traced(args.run_cycles)
         else:
             system, events = system.run_traced(args.max_cycles)
         kinds = ("instr", "msg") if args.trace_msgs else ("instr",)
         if events:
-            eventlog.write_log(args.trace_log, events, kinds)
+            eventlog.write_log(args.trace_log, events, kinds,
+                               base_cycle=trace_base)
         else:
             open(args.trace_log, "w").close()
     elif args.run_cycles is not None:
@@ -185,8 +220,18 @@ def main(argv=None) -> int:
                     "mailboxes — likely livelocked; rerun with --admission "
                     f"{max(1, cfg.queue_capacity // 6)} or a larger "
                     "--queue-capacity)")
+        if m["msgs_injected_dropped"] > 0:
+            hint += (f" ({m['msgs_injected_dropped']} messages dropped by "
+                     f"--drop-prob {cfg.drop_prob} fault injection)")
         print(f"warning: not quiescent after {args.max_cycles} cycles{hint}",
               file=sys.stderr)
+        stalled = system.stalled(args.stall_threshold)
+        if stalled:
+            print(f"watchdog: {len(stalled)} node(s) stalled "
+                  f">{args.stall_threshold} cycles on one request "
+                  f"(first few: {stalled[:4]}); recover by resuming a "
+                  "checkpoint with backpressure (--admission) or a "
+                  "different schedule", file=sys.stderr)
 
     if args.check or args.check_strict:
         try:

@@ -41,6 +41,14 @@ class SystemConfig:
     # ring (assignment.c:754-762); drops are always counted in metrics.
     overflow_policy: str = "drop"
 
+    # Fault injection: probability that an accepted message is dropped at
+    # delivery anyway (seedable via state.fault_key). The reference's only
+    # "fault" is the silent overflow drop (assignment.c:754-762); this
+    # generalizes it into a testable stress knob for the failure-detection
+    # surface (ops.failures): a dropped reply strands its requester, which
+    # the stall watchdog then flags. 0.0 = off (default, zero cost).
+    drop_prob: float = 0.0
+
     # Admission window (backpressure): maximum number of simultaneously
     # outstanding request transactions system-wide. The reference silently
     # drops on overflow (assignment.c:754-762), which at its dimensions is

@@ -94,7 +94,10 @@ class CoherenceSystem:
         Returns (system, events) where events is a dict of
         [cycles, N] host arrays (see ops.step.run_cycles_traced /
         utils.eventlog) — the reference's -DDEBUG_INSTR/-DDEBUG_MSG
-        tracing as data instead of interleaved printf.
+        tracing as data instead of interleaved printf. Event rows are
+        relative to the starting cycle: pass
+        ``base_cycle=int(state.cycle)`` (captured before the run) to
+        utils.eventlog for absolute cycle numbers.
 
         ``max_cycles`` is an absolute cap on ``state.cycle``, matching
         run(); the final chunk is trimmed so the cap is exact. Like
@@ -141,6 +144,15 @@ class CoherenceSystem:
     @property
     def instrs_retired(self) -> int:
         return int(self.state.metrics.instrs_retired)
+
+    # -- failure detection (SURVEY §5: reference has none) ----------------
+    def stalled(self, threshold: int = 100) -> List[dict]:
+        """Stall-watchdog report: nodes blocked on one outstanding
+        request for more than `threshold` cycles (e.g. stranded by a
+        dropped reply — injectable via cfg.drop_prob). Empty = healthy.
+        """
+        from ue22cs343bb1_openmp_assignment_tpu.ops import failures
+        return failures.stalled_nodes(self.cfg, self.state, threshold)
 
     # -- invariant checking (SURVEY §5: the TPU-way -DDEBUG build) --------
     def check_invariants(self, strict_coherence: bool = True) -> dict:

@@ -1,0 +1,65 @@
+"""Failure detection — the stall watchdog.
+
+The reference has none (SURVEY §5): a node whose reply is lost to the
+silent overflow drop (``assignment.c:754-762``) spins in its waiting
+loop forever (``assignment.c:624-629``), and only the harness's external
+``kill -9`` ends the process. Here blocking is explicit state
+(``waiting`` / ``waiting_since``), so detection is a reduction:
+
+* a node is **stalled** when it has been waiting on its one outstanding
+  request for more than `threshold` cycles — far beyond the protocol's
+  worst-case transaction latency (a 3-hop ownership transfer resolves in
+  ~4 cycles on an uncongested machine; queueing behind a hot home node
+  adds at most the queue depth),
+* the recovery path is deliberate: checkpoint → adjust schedule/admission
+  (backpressure prevents the drops in the first place: with an admission
+  window ≤ Q/6 no ring can overflow, config.admission_window) → resume.
+  Blind request re-issue is NOT offered — replaying a request whose
+  transaction half-completed corrupts the home directory, because the
+  protocol's handlers assume exactly-once delivery (e.g. a retried
+  WRITE_REQUEST on dir EM would WRITEBACK_INV the requester itself,
+  ``assignment.c:435-453``).
+
+Fault injection (cfg.drop_prob, ops.mailbox.deliver) exists to exercise
+exactly this surface.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.state import SimState
+
+DEFAULT_THRESHOLD = 100
+
+
+def stalled_mask(cfg: SystemConfig, state: SimState,
+                 threshold: int = DEFAULT_THRESHOLD) -> jnp.ndarray:
+    """[N] bool: waiting on one request for > threshold cycles."""
+    age = state.cycle - state.waiting_since
+    return state.waiting & (state.waiting_since >= 0) & (age > threshold)
+
+
+def stalled_count(cfg: SystemConfig, state: SimState,
+                  threshold: int = DEFAULT_THRESHOLD) -> jnp.ndarray:
+    return jnp.sum(stalled_mask(cfg, state, threshold)).astype(jnp.int32)
+
+
+def stalled_nodes(cfg: SystemConfig, state: SimState,
+                  threshold: int = DEFAULT_THRESHOLD,
+                  limit: int = 16) -> List[dict]:
+    """Host-side report: up to `limit` stalled nodes with the request
+    they are stuck on (node, since-cycle, op, addr)."""
+    import numpy as np
+
+    mask = np.asarray(stalled_mask(cfg, state, threshold))
+    ids = np.nonzero(mask)[0][:limit]
+    since = np.asarray(state.waiting_since)
+    op = np.asarray(state.cur_op)
+    addr = np.asarray(state.cur_addr)
+    return [{"node": int(n), "since_cycle": int(since[n]),
+             "op": "W" if int(op[n]) else "R",
+             "addr": int(addr[n])} for n in ids]

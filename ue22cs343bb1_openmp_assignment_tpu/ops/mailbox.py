@@ -105,7 +105,13 @@ def deliver(cfg: SystemConfig, state, cand: Candidates, arb_rank,
     the OS lock-acquisition order across concurrent senders. Lower rank
     enqueues first at every receiver this cycle.
 
-    Returns (state updates dict, dropped_count scalar).
+    When cfg.drop_prob > 0, each otherwise-accepted message is dropped
+    with that probability (fault injection, seeded by state.fault_key) —
+    the generalized form of the reference's silent overflow drop
+    (``assignment.c:754-762``) as a stress knob for the stall watchdog
+    (ops.failures).
+
+    Returns (state updates dict, dropped_count, injected_count).
     """
     N, S, Q = cfg.num_nodes, cfg.out_slots, cfg.queue_capacity
     F = N * S
@@ -149,6 +155,24 @@ def deliver(cfg: SystemConfig, state, cand: Candidates, arb_rank,
     safe_r = jnp.where(v_s, r_s, 0)
     free = (Q - new_count)[safe_r]
     accept = v_s & (rank < free)
+    dropped_overflow = jnp.sum(v_s & ~accept).astype(jnp.int32)
+
+    # fault injection: drop accepted messages with cfg.drop_prob
+    fault_key = state.fault_key
+    injected = jnp.zeros((), jnp.int32)
+    if cfg.drop_prob > 0.0:
+        import jax
+        key = jax.random.wrap_key_data(state.fault_key)
+        k_draw, k_next = jax.random.split(key)
+        hit = jax.random.bernoulli(k_draw, cfg.drop_prob, accept.shape)
+        injected = jnp.sum(accept & hit).astype(jnp.int32)
+        accept = accept & ~hit
+        # dropped messages would leave holes in the ring; re-rank the
+        # survivors within each receiver segment so writes stay dense
+        # seg_start >= 0 everywhere (is_start[0] is always True)
+        excl = jnp.cumsum(accept.astype(jnp.int32)) - accept.astype(jnp.int32)
+        rank = excl - excl[seg_start]
+        fault_key = jax.random.key_data(k_next).astype(jnp.uint32)
     pos = (new_head[safe_r] + new_count[safe_r] + rank) % Q
 
     tgt_r = jnp.where(accept, r_s, N)      # OOB row -> dropped by scatter
@@ -170,9 +194,9 @@ def deliver(cfg: SystemConfig, state, cand: Candidates, arb_rank,
         mb_head=new_head,
         mb_count=new_count.at[tgt_r].add(
             accept.astype(jnp.int32), mode="drop"),
+        fault_key=fault_key,
     )
-    dropped = jnp.sum(v_s & ~accept).astype(jnp.int32)
-    return updates, dropped
+    return updates, dropped_overflow, injected
 
 
 def jax_cummax(x: jnp.ndarray) -> jnp.ndarray:

@@ -92,6 +92,11 @@ def cycle(cfg: SystemConfig, state: SimState,
         bval, mode="drop")
 
     waiting = (state.waiting & ~m_upd["wait_clear"]) | f_upd["wait_set"]
+    # stall-watchdog input: cycle the current wait began (-1 when idle)
+    waiting_since = jnp.where(
+        waiting,
+        jnp.where(f_upd["wait_set"], state.cycle, state.waiting_since),
+        -1)
 
     fetch, l_op, l_addr, l_val = f_upd["latch"]
     cur_op = jnp.where(fetch, l_op, state.cur_op)
@@ -157,8 +162,8 @@ def cycle(cfg: SystemConfig, state: SimState,
         bitvec=c_bitvec)
 
     # ---- phase 3: delivery -----------------------------------------------
-    mb_upd, dropped = mailbox.deliver(cfg, state, cand, arb_rank,
-                                      new_head, new_count)
+    mb_upd, dropped, injected = mailbox.deliver(cfg, state, cand, arb_rank,
+                                                new_head, new_count)
 
     # Vectorized INV application (scale path; reference assumes INV never
     # fails and tracks no acks, assignment.c:358-361). The broadcast for
@@ -193,6 +198,7 @@ def cycle(cfg: SystemConfig, state: SimState,
         upgrades=mt.upgrades + f_stats["upgrades"],
         msgs_processed=msgs,
         msgs_dropped=mt.msgs_dropped + dropped,
+        msgs_injected_dropped=mt.msgs_injected_dropped + injected,
         invalidations=mt.invalidations + m_stats["invalidations"]
         + inv_applied,
         evictions=mt.evictions + m_stats["evictions"],
@@ -203,6 +209,7 @@ def cycle(cfg: SystemConfig, state: SimState,
         memory=memory, dir_state=dir_state, dir_bitvec=dir_bitvec,
         instr_idx=f_upd["new_idx"],
         cur_op=cur_op, cur_addr=cur_addr, cur_val=cur_val, waiting=waiting,
+        waiting_since=waiting_since,
         cycle=state.cycle + 1, metrics=metrics, **mb_upd)
     if not with_events:
         return new_state

@@ -38,6 +38,7 @@ class Metrics(struct.PyTreeNode):
     upgrades: jnp.ndarray        # [] i32 — S write-hits (UPGRADE sent)
     msgs_processed: jnp.ndarray  # [13] i32 — dequeues by transaction type
     msgs_dropped: jnp.ndarray    # [] i32 — ring-overflow drops (quirk 6)
+    msgs_injected_dropped: jnp.ndarray  # [] i32 — cfg.drop_prob faults
     invalidations: jnp.ndarray   # [] i32 — INV applications that hit a line
     evictions: jnp.ndarray       # [] i32 — EVICT_* notices sent
 
@@ -47,7 +48,8 @@ class Metrics(struct.PyTreeNode):
         return cls(cycles=z, instrs_retired=z, read_hits=z, write_hits=z,
                    read_misses=z, write_misses=z, upgrades=z,
                    msgs_processed=jnp.zeros((13,), jnp.int32),
-                   msgs_dropped=z, invalidations=z, evictions=z)
+                   msgs_dropped=z, msgs_injected_dropped=z,
+                   invalidations=z, evictions=z)
 
 
 class SimState(struct.PyTreeNode):
@@ -78,6 +80,11 @@ class SimState(struct.PyTreeNode):
     cur_addr: jnp.ndarray      # [N] i32
     cur_val: jnp.ndarray       # [N] i32
     waiting: jnp.ndarray       # [N] bool — waitingForReply (assignment.c:162)
+    # cycle at which `waiting` was last set (-1 when not waiting) — the
+    # stall watchdog's input (ops.failures; reference has no failure
+    # detection, SURVEY §5: a node stranded by a dropped reply just
+    # spins forever, assignment.c:624-629)
+    waiting_since: jnp.ndarray # [N] i32
 
     # -- mailboxes (reference messageBuffer, assignment.c:81-87) ----------
     mb_type: jnp.ndarray       # [N, Q] i32, Msg (NONE = empty slot)
@@ -106,6 +113,10 @@ class SimState(struct.PyTreeNode):
     # lock-acquisition order (quirk source for test_3/test_4).
     arb_rank: jnp.ndarray      # [N] i32 permutation of node ids
 
+    # PRNG state for fault injection (cfg.drop_prob); split each cycle
+    # inside delivery so drop patterns are reproducible from the seed.
+    fault_key: jnp.ndarray     # [2] u32
+
     cycle: jnp.ndarray         # [] i32
     metrics: Metrics
 
@@ -129,7 +140,7 @@ class SimState(struct.PyTreeNode):
 
 def init_state(cfg: SystemConfig, traces=None, issue_delay=None,
                issue_period=None, instr_arrays=None,
-               arb_rank=None) -> SimState:
+               arb_rank=None, fault_seed: int = 0) -> SimState:
     """Build the initial machine state.
 
     Mirrors ``initializeProcessor`` (``assignment.c:806-851``): memory
@@ -200,6 +211,7 @@ def init_state(cfg: SystemConfig, traces=None, issue_delay=None,
         cur_addr=jnp.zeros((N,), jnp.int32),
         cur_val=jnp.zeros((N,), jnp.int32),
         waiting=jnp.zeros((N,), bool),
+        waiting_since=jnp.full((N,), -1, jnp.int32),
         mb_type=jnp.full((N, Q), int(Msg.NONE), jnp.int32),
         mb_sender=jnp.zeros((N, Q), jnp.int32),
         mb_addr=jnp.zeros((N, Q), jnp.int32),
@@ -212,9 +224,15 @@ def init_state(cfg: SystemConfig, traces=None, issue_delay=None,
         issue_delay=jnp.asarray(issue_delay, jnp.int32),
         issue_period=jnp.asarray(issue_period, jnp.int32),
         arb_rank=jnp.asarray(arb_rank, jnp.int32),
+        fault_key=_fault_key(fault_seed),
         cycle=jnp.zeros((), jnp.int32),
         metrics=Metrics.zeros(),
     )
+
+
+def _fault_key(seed: int) -> jnp.ndarray:
+    import jax
+    return jax.random.key_data(jax.random.PRNGKey(seed)).astype(jnp.uint32)
 
 
 # -- bitvector helpers (tiled uint32 words; reference used one byte) ------

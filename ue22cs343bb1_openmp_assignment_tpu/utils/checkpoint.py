@@ -39,7 +39,7 @@ import numpy as np
 from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
 from ue22cs343bb1_openmp_assignment_tpu.state import Metrics, SimState
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2  # v2: + waiting_since, fault_key, injected-drop metric
 
 _CONFIG_KEY = "__config__"
 _META_KEY = "__meta__"

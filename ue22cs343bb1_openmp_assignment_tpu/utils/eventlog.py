@@ -78,9 +78,10 @@ def to_lines(events: Dict, kinds=("instr",),
             if r["kind"] in kinds]
 
 
-def write_log(path: str, events: Dict, kinds=("instr",)) -> None:
+def write_log(path: str, events: Dict, kinds=("instr",),
+              base_cycle: int = 0) -> None:
     with open(path, "w") as f:
-        for line in to_lines(events, kinds):
+        for line in to_lines(events, kinds, base_cycle):
             f.write(line + "\n")
 
 
