@@ -33,8 +33,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--out-dir", default=".",
                    help="where to write core_<n>_output.txt dumps")
     p.add_argument("--workload", choices=["uniform", "producer_consumer",
-                                          "false_sharing"],
-                   help="run a synthetic workload instead of trace files")
+                                          "false_sharing", "fft", "radix"],
+                   help="run a synthetic workload instead of trace files "
+                        "(fft/radix are SPLASH-2-style reference "
+                        "patterns)")
     p.add_argument("--nodes", type=int, default=4)
     p.add_argument("--trace-len", type=int, default=32)
     p.add_argument("--queue-capacity", type=int, default=None,
@@ -85,8 +87,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "with this probability (stress for the stall "
                         "watchdog; reference's only fault is the silent "
                         "overflow drop; default 0 = off)")
-    p.add_argument("--fault-seed", type=int, default=0,
-                   help="PRNG seed for --drop-prob injection")
+    p.add_argument("--fault-seed", type=int, default=None,
+                   help="PRNG seed for --drop-prob injection "
+                        "(default 0; on --resume, re-seeds the "
+                        "checkpointed fault PRNG when given)")
     p.add_argument("--stall-threshold", type=int, default=100,
                    help="cycles a node may wait on one request before "
                         "the watchdog reports it stalled")
@@ -161,6 +165,9 @@ def main(argv=None) -> int:
             system = _dc.replace(system, cfg=cfg)
         # schedule knobs override the checkpointed ones when given
         overrides = _schedule_knobs(args, cfg.num_nodes)
+        if args.fault_seed is not None:
+            from ue22cs343bb1_openmp_assignment_tpu.state import _fault_key
+            overrides["fault_key"] = _fault_key(args.fault_seed)
         if overrides:
             system = _dc.replace(
                 system, state=system.state.replace(**overrides))
@@ -170,13 +177,13 @@ def main(argv=None) -> int:
                                  admission_window=args.admission,
                                  drop_prob=args.drop_prob or 0.0)
         init_kw = _schedule_knobs(args, args.nodes)
-        init_kw["fault_seed"] = args.fault_seed
+        init_kw["fault_seed"] = args.fault_seed or 0
         system = CoherenceSystem.from_workload(
             cfg, args.workload, trace_len=args.trace_len, seed=args.seed,
             init_kw=init_kw)
     elif args.test_dir:
         init_kw = _schedule_knobs(args, args.nodes)
-        init_kw["fault_seed"] = args.fault_seed
+        init_kw["fault_seed"] = args.fault_seed or 0
         cfg = SystemConfig.reference(num_nodes=args.nodes,
                                      admission_window=args.admission,
                                      drop_prob=args.drop_prob or 0.0)
@@ -227,7 +234,10 @@ def main(argv=None) -> int:
               file=sys.stderr)
         stalled = system.stalled(args.stall_threshold)
         if stalled:
-            print(f"watchdog: {len(stalled)} node(s) stalled "
+            from ue22cs343bb1_openmp_assignment_tpu.ops import failures
+            n_stalled = int(failures.stalled_count(
+                cfg, system.state, args.stall_threshold))
+            print(f"watchdog: {n_stalled} node(s) stalled "
                   f">{args.stall_threshold} cycles on one request "
                   f"(first few: {stalled[:4]}); recover by resuming a "
                   "checkpoint with backpressure (--admission) or a "

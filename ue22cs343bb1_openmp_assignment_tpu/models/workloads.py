@@ -73,8 +73,65 @@ def false_sharing(key, cfg: SystemConfig, trace_len: int,
     return op, addr, val, jnp.full((N,), trace_len, jnp.int32)
 
 
+def fft_transpose(key, cfg: SystemConfig, trace_len: int):
+    """SPLASH-2 FFT-style butterfly/transpose reference pattern
+    (BASELINE.json "4096-core tiled directory" config).
+
+    The FFT kernel's communication is staged all-to-all: in stage s,
+    thread i exchanges with partner i XOR 2^s — it reads rows homed at
+    the partner and writes its own. Emulated per instruction slot t:
+    stage = t // 2 (mod log2 N), even t reads a partner block, odd t
+    writes a local block — a deterministic, strided cross-node pattern
+    with no write races (each node writes only its own home blocks).
+    """
+    N = cfg.num_nodes
+    k1, k2 = jax.random.split(key)
+    shape = (N, trace_len)
+    stages = max(1, (N - 1).bit_length())
+    ids = jnp.arange(N, dtype=jnp.int32)[:, None]
+    t = jnp.arange(trace_len, dtype=jnp.int32)[None, :]
+    stage = (t // 2) % stages
+    partner = (ids ^ (1 << stage)) % N
+    is_write = (t % 2) == 1
+    node = jnp.where(is_write, ids, partner)
+    block = jax.random.randint(k1, shape, 0, cfg.mem_size, dtype=jnp.int32)
+    addr = codec.make_address(cfg, jnp.broadcast_to(node, shape), block)
+    op = jnp.broadcast_to(
+        jnp.where(is_write, int(Op.WRITE), int(Op.READ)),
+        shape).astype(jnp.int32)
+    val = jax.random.randint(k2, shape, 0, 256, dtype=jnp.int32)
+    return op, addr, val, jnp.full((N,), trace_len, jnp.int32)
+
+
+def radix_sort(key, cfg: SystemConfig, trace_len: int, radix: int = 16):
+    """SPLASH-2 radix-sort-style pattern: local histogram reads followed
+    by a permutation phase that scatters writes to the node owning each
+    key's digit bucket (key-dependent all-to-all with write contention —
+    the racy counterpart to fft_transpose).
+    """
+    N = cfg.num_nodes
+    k1, k2, k3 = jax.random.split(key, 3)
+    shape = (N, trace_len)
+    ids = jnp.arange(N, dtype=jnp.int32)[:, None]
+    t = jnp.arange(trace_len, dtype=jnp.int32)[None, :]
+    # first half: local histogram builds (reads of own memory)
+    hist_phase = t < (trace_len // 2)
+    digit = jax.random.randint(k1, shape, 0, radix, dtype=jnp.int32)
+    bucket_node = (digit * N // radix) % N      # digit's home bucket
+    node = jnp.where(hist_phase, ids, bucket_node)
+    block = jax.random.randint(k2, shape, 0, cfg.mem_size, dtype=jnp.int32)
+    addr = codec.make_address(cfg, node, block)
+    op = jnp.broadcast_to(
+        jnp.where(hist_phase, int(Op.READ), int(Op.WRITE)),
+        shape).astype(jnp.int32)
+    val = jax.random.randint(k3, shape, 0, 256, dtype=jnp.int32)
+    return op, addr, val, jnp.full((N,), trace_len, jnp.int32)
+
+
 GENERATORS = {
     "uniform": uniform_random,
     "producer_consumer": producer_consumer,
     "false_sharing": false_sharing,
+    "fft": fft_transpose,
+    "radix": radix_sort,
 }
