@@ -56,16 +56,18 @@ def main():
     # measurement.
     max_cycles = 200 * args.trace_len
 
-    # warmup: compile the runner (discarded copy of the full run)
-    jax.block_until_ready(
-        run_chunked_to_quiescence(cfg, sys_.state, args.chunk, max_cycles))
+    # warmup: compile the runner (discarded copy of the full run).
+    # NOTE: sync via device_get (int()), NOT jax.block_until_ready — over
+    # a tunneled device plugin block_until_ready can return before the
+    # computation finishes, which silently turns the measurement into
+    # dispatch time and inflates throughput by orders of magnitude.
+    int(run_chunked_to_quiescence(cfg, sys_.state, args.chunk,
+                                  max_cycles).metrics.cycles)
 
     t0 = time.perf_counter()
     state = run_chunked_to_quiescence(cfg, sys_.state, args.chunk, max_cycles)
-    jax.block_until_ready(state)
+    retired = int(state.metrics.instrs_retired)   # device_get = real sync
     elapsed = time.perf_counter() - t0
-
-    retired = int(state.metrics.instrs_retired)
     value = retired / elapsed
     result = {
         "metric": f"simulated RD/WR instrs/sec @{args.nodes} cores "

@@ -22,9 +22,7 @@ def test_generator_shapes_and_ranges(name):
     op, addr, val = map(np.asarray, (op, addr, val))
     assert set(np.unique(op)) <= {int(Op.READ), int(Op.WRITE)}
     h = addr >> cfg.block_bits
-    b = addr & (cfg.mem_size - 1)
     assert (0 <= h).all() and (h < 64).all()
-    assert (0 <= b).all() and (b < cfg.mem_size).all()
     assert (0 <= val).all() and (val < 256).all()
 
 

@@ -166,8 +166,9 @@ def main(argv=None) -> int:
         # schedule knobs override the checkpointed ones when given
         overrides = _schedule_knobs(args, cfg.num_nodes)
         if args.fault_seed is not None:
-            from ue22cs343bb1_openmp_assignment_tpu.state import _fault_key
-            overrides["fault_key"] = _fault_key(args.fault_seed)
+            from ue22cs343bb1_openmp_assignment_tpu.state import (
+                fault_key_from_seed)
+            overrides["fault_key"] = fault_key_from_seed(args.fault_seed)
         if overrides:
             system = _dc.replace(
                 system, state=system.state.replace(**overrides))
@@ -232,16 +233,13 @@ def main(argv=None) -> int:
                      f"--drop-prob {cfg.drop_prob} fault injection)")
         print(f"warning: not quiescent after {args.max_cycles} cycles{hint}",
               file=sys.stderr)
-        stalled = system.stalled(args.stall_threshold)
-        if stalled:
-            from ue22cs343bb1_openmp_assignment_tpu.ops import failures
-            n_stalled = int(failures.stalled_count(
-                cfg, system.state, args.stall_threshold))
-            print(f"watchdog: {n_stalled} node(s) stalled "
+        report = system.stall_report(args.stall_threshold)
+        if report["count"]:
+            print(f"watchdog: {report['count']} node(s) stalled "
                   f">{args.stall_threshold} cycles on one request "
-                  f"(first few: {stalled[:4]}); recover by resuming a "
-                  "checkpoint with backpressure (--admission) or a "
-                  "different schedule", file=sys.stderr)
+                  f"(first few: {report['nodes'][:4]}); recover by "
+                  "resuming a checkpoint with backpressure (--admission) "
+                  "or a different schedule", file=sys.stderr)
 
     if args.check or args.check_strict:
         try:

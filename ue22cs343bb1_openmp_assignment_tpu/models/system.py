@@ -146,13 +146,17 @@ class CoherenceSystem:
         return int(self.state.metrics.instrs_retired)
 
     # -- failure detection (SURVEY §5: reference has none) ----------------
-    def stalled(self, threshold: int = 100) -> List[dict]:
-        """Stall-watchdog report: nodes blocked on one outstanding
-        request for more than `threshold` cycles (e.g. stranded by a
-        dropped reply — injectable via cfg.drop_prob). Empty = healthy.
-        """
+    def stall_report(self, threshold: int = 100) -> dict:
+        """Stall-watchdog report ({"count", "nodes"}): nodes blocked on
+        one outstanding request for more than `threshold` cycles (e.g.
+        stranded by a dropped reply — injectable via cfg.drop_prob).
+        count == 0 means healthy. One device evaluation."""
         from ue22cs343bb1_openmp_assignment_tpu.ops import failures
-        return failures.stalled_nodes(self.cfg, self.state, threshold)
+        return failures.stall_report(self.cfg, self.state, threshold)
+
+    def stalled(self, threshold: int = 100) -> List[dict]:
+        """Truncated node list form of :meth:`stall_report`."""
+        return self.stall_report(threshold)["nodes"]
 
     # -- invariant checking (SURVEY §5: the TPU-way -DDEBUG build) --------
     def check_invariants(self, strict_coherence: bool = True) -> dict:

@@ -48,18 +48,27 @@ def stalled_count(cfg: SystemConfig, state: SimState,
     return jnp.sum(stalled_mask(cfg, state, threshold)).astype(jnp.int32)
 
 
-def stalled_nodes(cfg: SystemConfig, state: SimState,
-                  threshold: int = DEFAULT_THRESHOLD,
-                  limit: int = 16) -> List[dict]:
-    """Host-side report: up to `limit` stalled nodes with the request
-    they are stuck on (node, since-cycle, op, addr)."""
+def stall_report(cfg: SystemConfig, state: SimState,
+                 threshold: int = DEFAULT_THRESHOLD,
+                 limit: int = 16) -> dict:
+    """Host-side report from ONE device evaluation of the mask:
+    {"count": total stalled, "nodes": up to `limit` entries with the
+    stuck request (node, since-cycle, op, addr)}."""
     import numpy as np
 
     mask = np.asarray(stalled_mask(cfg, state, threshold))
-    ids = np.nonzero(mask)[0][:limit]
+    ids = np.nonzero(mask)[0]
     since = np.asarray(state.waiting_since)
     op = np.asarray(state.cur_op)
     addr = np.asarray(state.cur_addr)
-    return [{"node": int(n), "since_cycle": int(since[n]),
-             "op": "W" if int(op[n]) else "R",
-             "addr": int(addr[n])} for n in ids]
+    return {"count": int(mask.sum()),
+            "nodes": [{"node": int(n), "since_cycle": int(since[n]),
+                       "op": "W" if int(op[n]) else "R",
+                       "addr": int(addr[n])} for n in ids[:limit]]}
+
+
+def stalled_nodes(cfg: SystemConfig, state: SimState,
+                  threshold: int = DEFAULT_THRESHOLD,
+                  limit: int = 16) -> List[dict]:
+    """Back-compat list form of :func:`stall_report`."""
+    return stall_report(cfg, state, threshold, limit)["nodes"]

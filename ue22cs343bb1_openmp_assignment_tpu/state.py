@@ -224,13 +224,13 @@ def init_state(cfg: SystemConfig, traces=None, issue_delay=None,
         issue_delay=jnp.asarray(issue_delay, jnp.int32),
         issue_period=jnp.asarray(issue_period, jnp.int32),
         arb_rank=jnp.asarray(arb_rank, jnp.int32),
-        fault_key=_fault_key(fault_seed),
+        fault_key=fault_key_from_seed(fault_seed),
         cycle=jnp.zeros((), jnp.int32),
         metrics=Metrics.zeros(),
     )
 
 
-def _fault_key(seed: int) -> jnp.ndarray:
+def fault_key_from_seed(seed: int) -> jnp.ndarray:
     import jax
     return jax.random.key_data(jax.random.PRNGKey(seed)).astype(jnp.uint32)
 
