@@ -6,6 +6,12 @@ reference suites (covered by tests/). The reference publishes no
 throughput numbers (BASELINE.md), so vs_baseline is measured against the
 north-star target.
 
+Engines (see PERF.md for the measured rationale):
+  sync   (default) — transactional engine (ops.sync_engine): atomic
+         whole-transaction rounds, no mailboxes; the throughput path.
+  async  — message-level engine (ops.step): reference network semantics
+         cycle by cycle; the parity/race-research path.
+
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 """
@@ -18,15 +24,18 @@ import time
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--engine", choices=["sync", "async"], default="sync")
     ap.add_argument("--nodes", type=int, default=4096)
     ap.add_argument("--trace-len", type=int, default=96)
     ap.add_argument("--chunk", type=int, default=64,
-                    help="cycles per timed device call")
+                    help="cycles/rounds per timed device call")
     ap.add_argument("--workload", default="uniform")
     ap.add_argument("--local-frac", type=float, default=0.8)
+    ap.add_argument("--drain-depth", type=int, default=8,
+                    help="sync engine: hit-burst length per round")
     ap.add_argument("--admission", type=int, default=None,
-                    help="max concurrent outstanding requests (backpressure "
-                         "window; None = reference drop semantics)")
+                    help="async engine: max concurrent outstanding "
+                         "requests (None = reference drop semantics)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny config on CPU for smoke testing")
     args = ap.parse_args()
@@ -38,6 +47,7 @@ def main():
 
     from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
     from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
+    from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
     from ue22cs343bb1_openmp_assignment_tpu.ops.step import (
         run_chunked_to_quiescence)
 
@@ -45,7 +55,8 @@ def main():
         args.nodes, args.trace_len, args.chunk = 64, 8, 8
 
     cfg = SystemConfig.scale(num_nodes=args.nodes,
-                             admission_window=args.admission)
+                             admission_window=args.admission,
+                             drain_depth=args.drain_depth)
     gen_kw = {"local_frac": args.local_frac} if args.workload == "uniform" else {}
     sys_ = CoherenceSystem.from_workload(
         cfg, args.workload, trace_len=args.trace_len, seed=0, **gen_kw)
@@ -56,34 +67,54 @@ def main():
     # measurement.
     max_cycles = 200 * args.trace_len
 
-    # warmup: compile the runner (discarded copy of the full run).
-    # NOTE: sync via device_get (int()), NOT jax.block_until_ready — over
-    # a tunneled device plugin block_until_ready can return before the
-    # computation finishes, which silently turns the measurement into
-    # dispatch time and inflates throughput by orders of magnitude.
-    int(run_chunked_to_quiescence(cfg, sys_.state, args.chunk,
-                                  max_cycles).metrics.cycles)
+    # warmup: compile + run the full workload once (discarded); sync via
+    # device_get (int()), NOT jax.block_until_ready — over a tunneled
+    # device plugin block_until_ready can return before the computation
+    # finishes, which silently turns the measurement into dispatch time
+    # and inflates throughput by orders of magnitude.
+    if args.engine == "sync":
+        st0 = se.from_sim_state(cfg, sys_.state, seed=0)
+
+        def run():
+            return se.run_sync_to_quiescence(cfg, st0, args.chunk,
+                                             max_cycles)
+
+        def steps(st):
+            return int(st.metrics.rounds)
+    else:
+        def run():
+            return run_chunked_to_quiescence(cfg, sys_.state, args.chunk,
+                                             max_cycles)
+
+        def steps(st):
+            return int(st.metrics.cycles)
+
+    int(run().metrics.instrs_retired)
 
     t0 = time.perf_counter()
-    state = run_chunked_to_quiescence(cfg, sys_.state, args.chunk, max_cycles)
+    state = run()
     retired = int(state.metrics.instrs_retired)   # device_get = real sync
     elapsed = time.perf_counter() - t0
     value = retired / elapsed
     result = {
         "metric": f"simulated RD/WR instrs/sec @{args.nodes} cores "
-                  f"({args.workload}, 1 chip, "
+                  f"({args.engine} engine, {args.workload}, 1 chip, "
                   f"{jax.devices()[0].platform})",
         "value": round(value, 1),
         "unit": "instrs/sec",
         "vs_baseline": round(value / 1e8, 4),
     }
     extra = {
-        "cycles": int(state.metrics.cycles),
+        "engine": args.engine,
+        "steps": steps(state),
         "retired": retired,
         "quiescent": bool(state.quiescent()),
         "elapsed_s": round(elapsed, 3),
-        "msgs_dropped": int(state.metrics.msgs_dropped),
     }
+    if args.engine == "async":
+        # surface the reference's silent-drop failure mode (quirk 6): a
+        # throughput number with drops > 0 is not a clean run
+        extra["msgs_dropped"] = int(state.metrics.msgs_dropped)
     print(json.dumps(result))
     print(json.dumps(extra), file=sys.stderr)
 

@@ -1,0 +1,42 @@
+"""Device calibration: raw MXU/VPU throughput (throwaway)."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def bench(name, fn, *xs, work=1):
+    r = fn(*xs)
+    int(jax.tree.leaves(r)[0].ravel()[0])
+    t0 = time.perf_counter()
+    r = fn(*xs)
+    int(jax.tree.leaves(r)[0].ravel()[0])
+    dt = time.perf_counter() - t0
+    print(f"{name:46s} {dt:8.4f}s  -> {work/dt:10.3e} /s")
+
+
+K = 32
+a = jnp.ones((1024, 1024), jnp.bfloat16)
+
+@jax.jit
+def mm_chain(a):
+    def step(c, _):
+        c = c @ a
+        return c * jnp.bfloat16(1e-3), None
+    out, _ = jax.lax.scan(step, a, None, length=K)
+    return out
+
+bench(f"bf16 1024^3 matmul x{K} (scan)", mm_chain, a,
+      work=K * 2 * 1024**3)  # flops
+
+v = jnp.ones((512, 1024), jnp.float32)
+
+@jax.jit
+def vec_chain(v):
+    def step(c, _):
+        return (c * 1.000001 + 0.5) * 0.999999 - 0.25, None
+    out, _ = jax.lax.scan(step, v, None, length=K)
+    return out
+
+bench(f"f32 elementwise 4 ops on 512x1024 x{K}", vec_chain, v,
+      work=K * 4 * 512 * 1024)  # element-ops

@@ -1,0 +1,64 @@
+"""In-kernel cost scaling: ops-per-step vs time (throwaway)."""
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+STEPS = 2000
+
+
+def bench(name, kernel, x):
+    @jax.jit
+    def run(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        )(x)
+
+    r = run(x)
+    int(r.ravel()[0])
+    t0 = time.perf_counter()
+    r = run(x)
+    int(r.ravel()[0])
+    dt = time.perf_counter() - t0
+    print(f"{name:56s} {dt/STEPS*1e6:9.2f} us/step")
+
+
+def mk(n_ops):
+    def kernel(x_ref, o_ref):
+        def body(i, acc):
+            for k in range(n_ops):
+                acc = acc + (acc & (k + 1))
+            return acc
+        o_ref[:] = jax.lax.fori_loop(0, STEPS, body, x_ref[:])
+    return kernel
+
+
+small = jnp.ones((8, 128), jnp.int32)       # 1 native tile
+med = jnp.ones((256, 128), jnp.int32)       # 32k elems
+big = jnp.ones((4096, 128), jnp.int32)      # 512k elems
+
+for n_ops in (2, 8, 32, 128):
+    bench(f"[8,128]    {n_ops:3d} int ops/step", mk(n_ops), small)
+for n_ops in (2, 8, 32):
+    bench(f"[256,128]  {n_ops:3d} int ops/step", mk(n_ops), med)
+for n_ops in (2, 8):
+    bench(f"[4096,128] {n_ops:3d} int ops/step", mk(n_ops), big)
+
+
+# dynamic-index load/store inside kernel (the delivery primitive)
+def dyn_kernel(x_ref, o_ref):
+    def body(i, acc):
+        j = (i * 7) % 256
+        row = x_ref[j, :]          # dynamic row load
+        o_ref[(j + 1) % 256, :] = row + acc[0, 0]
+        return acc + 1
+    o_ref[:] = x_ref[:]
+    acc = jax.lax.fori_loop(0, STEPS, body, jnp.ones((8, 128), jnp.int32))
+    o_ref[0, :] = acc[0, :]
+
+bench("[256,128] dynamic row load+store per step", dyn_kernel, med)

@@ -1,0 +1,112 @@
+"""In-kernel matmul + factored-gather cost (throwaway).
+
+The whole-simulation mega-kernel needs cross-node gathers (value[idx[r]]
+for arbitrary node ids). TPU has no vector gather; the candidate is a
+factored one-hot matmul: idx = hi*128+lo, H[r,hi] one-hot [N,32],
+L[r,lo] one-hot [N,128], T=vals.reshape(32,128):
+    out[r] = sum_lo L[r,lo] * (H @ T)[r,lo]
+Cost per gathered field ~= one [4096,32]@[32,128] matmul + 2 vec ops.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N, STEPS = 4096, 1000
+
+
+def bench(name, kernel, *xs, out_shape=None):
+    @jax.jit
+    def run(*xs):
+        return pl.pallas_call(
+            kernel,
+            out_shape=out_shape or jax.ShapeDtypeStruct(xs[0].shape,
+                                                        xs[0].dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM) for _ in xs],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        )(*xs)
+
+    r = run(*xs)
+    int(jax.tree.leaves(r)[0].ravel()[0])
+    t0 = time.perf_counter()
+    r = run(*xs)
+    int(jax.tree.leaves(r)[0].ravel()[0])
+    dt = time.perf_counter() - t0
+    print(f"{name:56s} {dt/STEPS*1e6:9.2f} us/step")
+
+
+# 1. in-kernel matmul [4096,32]@[32,128] f32 per step
+a = jnp.ones((N, 32), jnp.float32)
+b = jnp.ones((32, 128), jnp.float32)
+
+def mm_kernel(a_ref, b_ref, o_ref):
+    def body(i, acc):
+        return acc + jnp.dot(a_ref[:], b_ref[:],
+                             preferred_element_type=jnp.float32) * 1e-9
+    o_ref[:] = jax.lax.fori_loop(
+        0, STEPS, body, jnp.zeros((N, 128), jnp.float32))
+
+bench("matmul [4096,32]@[32,128] f32", mm_kernel, a, b,
+      out_shape=jax.ShapeDtypeStruct((N, 128), jnp.float32))
+
+# 2. full factored gather: build one-hots from idx, matmul, reduce
+idx = (jnp.arange(N, dtype=jnp.int32) * 2654435 % N).astype(jnp.int32)
+vals = jnp.arange(N, dtype=jnp.int32).reshape(32, 128).astype(jnp.float32)
+idx2 = idx.reshape(32, 128)
+
+def gather_kernel(idx_ref, val_ref, o_ref):
+    iota_hi = jax.lax.broadcasted_iota(jnp.int32, (N, 32), 1)
+    iota_lo = jax.lax.broadcasted_iota(jnp.int32, (N, 128), 1)
+
+    def body(i, acc):
+        ix = idx_ref[:].reshape(N)  # wait: [32,128] stored; flatten
+        ixf = idx_ref[:].astype(jnp.int32).reshape(-1)[:, None]
+        hi = (ixf // 128 == iota_hi).astype(jnp.float32)    # [N,32]
+        lo = (ixf % 128 == iota_lo).astype(jnp.float32)     # [N,128]
+        g = jnp.dot(hi, val_ref[:], preferred_element_type=jnp.float32)
+        out = jnp.sum(g * lo, axis=1).reshape(32, 128)      # [N]
+        return acc + out * 1e-9
+    o_ref[:] = jax.lax.fori_loop(
+        0, STEPS, body, jnp.zeros((32, 128), jnp.float32))
+
+bench("factored one-hot gather [4096] (full pipeline)", gather_kernel,
+      idx2, vals, out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32))
+
+# 3. bitonic compare-exchange stage cost estimate: roll + min/max on [32,128]
+x = jnp.arange(N, dtype=jnp.int32).reshape(32, 128).astype(jnp.float32)
+
+def bitonic_stage_kernel(x_ref, o_ref):
+    def body(i, acc):
+        for sh in (1, 2, 4, 8):  # 4 stages worth of lane rolls
+            r = pltpu.roll(acc, sh, 1)
+            acc = jnp.where((jax.lax.broadcasted_iota(
+                jnp.int32, (32, 128), 1) & sh) == 0,
+                jnp.minimum(acc, r), jnp.maximum(acc, r))
+        return acc
+    o_ref[:] = jax.lax.fori_loop(0, STEPS, body, x_ref[:])
+
+bench("4x lane roll+cmpexch stages [32,128]", bitonic_stage_kernel, x)
+
+# 4. big elementwise: does 16M-element op cost same as 4k?
+big = jnp.ones((4096, 4096), jnp.int32)  # 64MB -- likely OOMs VMEM; try HBM->auto
+try:
+    def big_kernel(x_ref, o_ref):
+        def body(i, acc):
+            return (acc + 1) ^ (acc & 7)
+        o_ref[:] = jax.lax.fori_loop(0, 100, body, x_ref[:])
+
+    @jax.jit
+    def run_big(x):
+        return pl.pallas_call(
+            big_kernel,
+            out_shape=jax.ShapeDtypeStruct(big.shape, big.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        )(x)
+    r = run_big(big); int(r.ravel()[0])
+    t0 = time.perf_counter(); r = run_big(big); int(r.ravel()[0])
+    print(f"{'16M-elem 2 ops x100 steps':56s} {(time.perf_counter()-t0)/100*1e6:9.2f} us/step")
+except Exception as e:
+    print("16M-elem VMEM test failed:", str(e)[:200])

@@ -1,0 +1,104 @@
+"""Pallas viability probe: launch overhead vs in-kernel loop cost (throwaway)."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N, K = 4096, 64
+
+
+def bench(name, fn, *xs, iters=K):
+    r = fn(*xs)
+    int(jax.tree.leaves(r)[0].ravel()[0])
+    t0 = time.perf_counter()
+    r = fn(*xs)
+    int(jax.tree.leaves(r)[0].ravel()[0])
+    dt = time.perf_counter() - t0
+    print(f"{name:52s} {dt/iters*1e6:9.1f} us/iter  ({dt:.3f}s total)")
+
+
+v = jnp.ones((32, 128), jnp.int32)
+
+# 1. trivial pallas kernel launched per scan iteration
+def triv_kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:] + 1
+
+def triv(x):
+    return pl.pallas_call(
+        triv_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )(x)
+
+@jax.jit
+def scan_pallas(x):
+    def step(c, _):
+        return triv(c), None
+    out, _ = jax.lax.scan(step, x, None, length=K)
+    return out
+
+bench("pallas trivial kernel per scan iter", scan_pallas, v)
+
+# 2. one pallas kernel with an internal fori_loop of K*R steps
+R = 100
+def loop_kernel(x_ref, o_ref):
+    def body(i, acc):
+        return (acc + 1) ^ (acc & 5) | (acc + 3)
+    o_ref[:] = jax.lax.fori_loop(0, K * R, body, x_ref[:])
+
+@jax.jit
+def one_kernel_loop(x):
+    return pl.pallas_call(
+        loop_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )(x)
+
+bench(f"pallas ONE kernel, {K*R} fori_loop steps inside",
+      one_kernel_loop, v, iters=K * R)
+
+# 3. same but with a bigger array [4096, 128] (2MB) to see VMEM compute rate
+big = jnp.ones((N, 128), jnp.int32)
+bench(f"pallas ONE kernel {K*R} steps on [4096,128]",
+      one_kernel_loop, big, iters=K * R)
+
+# 4. grid-based: grid=(K,) sequential steps, in-place accumulate
+def grid_kernel(x_ref, o_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        o_ref[:] = x_ref[:]
+
+    o_ref[:] = (o_ref[:] + 1) ^ (o_ref[:] & 5)
+
+@jax.jit
+def grid_loop(x):
+    return pl.pallas_call(
+        grid_kernel,
+        grid=(K,),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(x.shape, lambda i: (0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(x.shape, lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+    )(x)
+
+bench("pallas grid=(64,) sequential, per grid step", grid_loop, big)
+
+# 5. XLA while_loop (not scan) per-iter floor for comparison
+@jax.jit
+def xla_while(x):
+    def cond(c):
+        return c[1] < K
+    def body(c):
+        x, i = c
+        return ((x + 1) ^ (x & 5), i + 1)
+    return jax.lax.while_loop(cond, body, (x, 0))[0]
+
+bench("XLA while_loop trivial body", xla_while, big)

@@ -1,0 +1,73 @@
+"""Microbenchmark TPU primitive costs inside a scan (throwaway)."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+N, C, Q, K = 4096, 16, 64, 512
+
+
+def bench(name, body, *xs):
+    @jax.jit
+    def run(*xs):
+        def step(c, _):
+            return body(*c), None
+        out, _ = jax.lax.scan(step, xs, None, length=K)
+        return out
+
+    r = run(*xs)
+    int(jax.tree.leaves(r)[0].ravel()[0])  # device_get = real sync
+    t0 = time.perf_counter()
+    r = run(*xs)
+    int(jax.tree.leaves(r)[0].ravel()[0])  # device_get sync
+    dt = time.perf_counter() - t0
+    print(f"{name:44s} {dt/K*1e6:9.1f} us/iter")
+
+
+arr = jnp.zeros((N, C), jnp.int32)
+idx = jnp.arange(N, dtype=jnp.int32) % C
+val = jnp.arange(N, dtype=jnp.int32)
+rows = jnp.arange(N, dtype=jnp.int32)
+
+bench("row-scatter arr.at[rows, idx].set",
+      lambda a, i, v: (a.at[rows, i].set(v), (i + v[0]) % C, v + 1),
+      arr, idx, val)
+
+bench("row-scatter as one-hot where",
+      lambda a, i, v: (jnp.where(jnp.arange(C)[None, :] == i[:, None],
+                                 v[:, None], a), (i + v[0]) % C, v + 1),
+      arr, idx, val)
+
+bench("row-gather arr[rows, idx]",
+      lambda a, i, v: (a, (i + a[rows, i][0]) % C, v + 1), arr, idx, val)
+
+bench("row-gather as one-hot sum",
+      lambda a, i, v: (a, (i + jnp.sum(
+          jnp.where(jnp.arange(C)[None, :] == i[:, None], a, 0),
+          axis=1)[0]) % C, v + 1), arr, idx, val)
+
+big = jnp.zeros((N, Q), jnp.int32)
+F = N * 3
+tr = jnp.arange(F, dtype=jnp.int32) % N
+tp = jnp.arange(F, dtype=jnp.int32) % Q
+fv = jnp.arange(F, dtype=jnp.int32)
+
+bench("free scatter [F]->[N,Q] .at[tr,tp].set",
+      lambda a, r, p, v: (a.at[r, p].set(v, mode="drop"),
+                          (r + v[0]) % N, p, v + 1), big, tr, tp, fv)
+
+bench("free gather [N,Q]<-[F] flat-index",
+      lambda a, r, p, v: (a, (r + a.reshape(-1)[(r * Q + p) % (N * Q)][0]) % N,
+                          p, v + 1), big, tr, tp, fv)
+
+key = jnp.arange(F, dtype=jnp.int32)[::-1]
+bench("argsort [12288] i32",
+      lambda k: ((jnp.argsort(k) + k[0]).astype(jnp.int32),), key)
+
+bench("sort [12288] i32 keys only",
+      lambda k: ((jnp.sort(k) + k[0]).astype(jnp.int32),), key)
+
+two = jnp.zeros((N,), jnp.int32)
+bench("pure elementwise [N] x20",
+      lambda v: (((v * 3 + 1) % 1000 + (v // 7) * 2 - (v ^ 5) + (v & 31)
+                  + (v | 2) - (v % 13) + v * v % 97,)), two)

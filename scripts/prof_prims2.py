@@ -1,0 +1,63 @@
+"""Finer primitive microbenchmarks (throwaway)."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+N, K = 4096, 512
+
+
+def bench(name, body, *xs):
+    @jax.jit
+    def run(*xs):
+        def step(c, _):
+            return body(*c), None
+        out, _ = jax.lax.scan(step, xs, None, length=K)
+        return out
+
+    r = run(*xs)
+    int(jax.tree.leaves(r)[0].ravel()[0])  # device_get = real sync
+    t0 = time.perf_counter()
+    r = run(*xs)
+    int(jax.tree.leaves(r)[0].ravel()[0])
+    dt = time.perf_counter() - t0
+    print(f"{name:44s} {dt/K*1e6:9.1f} us/iter")
+
+
+v = jnp.ones((N,), jnp.int32)
+
+bench("add/xor/and x20 [4096]",
+      lambda v: ((v + 1) ^ (v + 2) & (v + 3) | (v - 4) + (v + 5)
+                 ^ (v + 6) + (v + 7) & (v + 8) + (v + 9) ^ (v + 10),), v)
+
+bench("one signed mod %97 [4096]", lambda v: (v % 97 + 1,), v)
+bench("one signed div //7 [4096]", lambda v: (v // 7 + 1,), v)
+bench("one uint32 mod %97 [4096]",
+      lambda v: (v % jnp.uint32(97) + 1,), v.astype(jnp.uint32))
+bench("mod by pow2 &63 [4096]", lambda v: ((v & 63) + 1,), v)
+
+m = jnp.ones((N, 16), jnp.int32)
+bench("add x5 [4096,16]",
+      lambda m: (m + 1 + (m ^ 3) + (m & 7) + (m | 9) + 2,), m)
+
+# scalar dynamic-slice in carry (v[0]) cost
+bench("v[0] scalar extract in carry",
+      lambda v: (v + v[0],), v)
+
+# int64 presence check
+bench("i32 mul-hi via 64-bit? (v*v)>>1",
+      lambda v: ((v * v) >> 1,), v)
+
+idx = jnp.arange(N, dtype=jnp.int32) % 16
+bench("take_along_axis [4096,16] axis1",
+      lambda m, i: (m + 1, (i + m[jnp.arange(N), i][0]) % 16), m, idx)
+
+# argsort variants F=12288
+F = 12288
+key = (jnp.arange(F, dtype=jnp.int32) * 264435761 % 100003)
+bench("argsort [12288]", lambda k: (jnp.argsort(k) % 7 + k[:1],), key)
+bench("sort-pair (k,iota) lax.sort 2-operand",
+      lambda k: (jax.lax.sort((k, jnp.arange(F, dtype=jnp.int32)),
+                              num_keys=1)[1] % 7 + k[:1],), key)
+ku = key.astype(jnp.uint32)
+bench("sort u32 keys only", lambda k: (jnp.sort(k) + k[:1],), ku)

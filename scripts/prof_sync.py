@@ -1,0 +1,27 @@
+"""Per-round cost ablation for the sync engine (throwaway)."""
+import time
+
+import jax
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
+from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+
+K = 256
+
+
+def timeit(cfg, st):
+    se.run_rounds.clear_cache()
+    out = se.run_rounds(cfg, st, K)
+    int(out.metrics.rounds)
+    t0 = time.perf_counter()
+    out = se.run_rounds(cfg, st, K)
+    int(out.metrics.rounds)
+    return (time.perf_counter() - t0) / K * 1e6
+
+
+for H in (0, 2, 8, 16):
+    cfg = SystemConfig.scale(num_nodes=4096, drain_depth=H)
+    sys_ = CoherenceSystem.from_workload(cfg, "uniform", trace_len=96, seed=0)
+    st = se.from_sim_state(cfg, sys_.state)
+    print(f"drain_depth={H:2d}: {timeit(cfg, st):8.1f} us/round")

@@ -1,0 +1,223 @@
+"""Synchronous transactional engine (ops.sync_engine).
+
+Validates that the round-based atomic-transaction engine executes the
+same protocol as the async message-level engine:
+
+* byte-exact golden dumps on the deterministic reference suites,
+* final-state agreement with the async engine on node-local traffic,
+* the exact-directory invariant (dir state/count/owner always consistent
+  with the set of valid tag-matching cache lines) on cross-node traffic,
+* progress under adversarial all-nodes-one-address contention,
+* seed determinism.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.conftest import REFERENCE_TESTS, requires_reference
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
+from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+from ue22cs343bb1_openmp_assignment_tpu.ops.step import run_to_quiescence
+from ue22cs343bb1_openmp_assignment_tpu.state import init_state
+from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState
+from ue22cs343bb1_openmp_assignment_tpu.utils.golden import (format_node_dump,
+                                                             state_to_dumps)
+from ue22cs343bb1_openmp_assignment_tpu.utils.trace import load_test_dir
+
+CFG = SystemConfig.reference()
+
+
+def run_sync_suite(suite, seed=0):
+    traces = load_test_dir(os.path.join(REFERENCE_TESTS, suite))
+    st = se.from_sim_state(CFG, init_state(CFG, traces), seed=seed)
+    final = se.run_sync_to_quiescence(CFG, st, 8, 10_000)
+    assert bool(final.quiescent()), f"{suite} did not quiesce"
+    return final
+
+
+@requires_reference
+@pytest.mark.parametrize("suite", ["sample", "test_1", "test_2"])
+def test_deterministic_suites_byte_exact(suite):
+    final = run_sync_suite(suite)
+    dumps = [format_node_dump(d)
+             for d in state_to_dumps(CFG, se.to_dump_view(CFG, final))]
+    for n in range(4):
+        golden = open(f"{REFERENCE_TESTS}/{suite}/core_{n}_output.txt").read()
+        assert dumps[n] == golden, f"{suite} core_{n} diverged"
+
+
+def check_exact_directory(cfg, st):
+    """The engine's core invariant (module docstring): the directory is
+    never stale — count/owner/state follow from cache tags alone."""
+    N, C, M = cfg.num_nodes, cfg.cache_size, cfg.mem_size
+    S = 1 << cfg.block_bits
+    ca = np.asarray(st.cache_addr)
+    cs = np.asarray(st.cache_state)
+    dm = np.asarray(st.dm).reshape(N, S, se.DM_COLS)
+    holders = {}
+    for n in range(N):
+        for c in range(C):
+            if cs[n, c] != int(CacheState.INVALID):
+                holders.setdefault(int(ca[n, c]), []).append((n, cs[n, c]))
+    for home in range(N):
+        for b in range(M):
+            a = (home << cfg.block_bits) | b
+            hs = holders.get(a, [])
+            state = dm[home, b, se.DM_STATE]
+            count = dm[home, b, se.DM_COUNT]
+            owner = dm[home, b, se.DM_OWNER]
+            if state == int(DirState.U):
+                assert not hs, f"U entry {a:#x} has holders {hs}"
+            elif state == int(DirState.EM):
+                assert count == 1 and len(hs) == 1, (
+                    f"EM entry {a:#x}: count={count} holders={hs}")
+                n, s = hs[0]
+                assert n == owner, f"EM entry {a:#x}: owner {owner} != {n}"
+                assert s in (int(CacheState.MODIFIED),
+                             int(CacheState.EXCLUSIVE)), s
+            else:
+                assert count == len(hs) and count >= 1, (
+                    f"S entry {a:#x}: count={count} holders={hs}")
+                assert all(s == int(CacheState.SHARED) for _, s in hs), hs
+
+
+def test_matches_async_on_local_traffic():
+    """All-local traces are schedule-independent (SURVEY §4): both engines
+    must land on identical cache/memory/directory state."""
+    rng = np.random.default_rng(7)
+    N, M = 8, 16
+    cfg = SystemConfig.reference(num_nodes=N)
+    traces = []
+    for n in range(N):
+        tr = []
+        for _ in range(24):
+            b = int(rng.integers(M))
+            if rng.random() < 0.5:
+                tr.append((0, n * M + b, 0))
+            else:
+                tr.append((1, n * M + b, int(rng.integers(256))))
+        traces.append(tr)
+    a_final = run_to_quiescence(cfg, init_state(cfg, traces), 50_000)
+    assert bool(a_final.quiescent())
+    s_final = se.run_sync_to_quiescence(
+        cfg, se.from_sim_state(cfg, init_state(cfg, traces)), 8, 50_000)
+    assert bool(s_final.quiescent())
+    mem, ds, bv = se.to_sim_arrays(cfg, s_final)
+    np.testing.assert_array_equal(mem, np.asarray(a_final.memory))
+    np.testing.assert_array_equal(ds, np.asarray(a_final.dir_state))
+    np.testing.assert_array_equal(bv, np.asarray(a_final.dir_bitvec))
+    np.testing.assert_array_equal(np.asarray(s_final.cache_addr),
+                                  np.asarray(a_final.cache_addr))
+    np.testing.assert_array_equal(np.asarray(s_final.cache_val),
+                                  np.asarray(a_final.cache_val))
+    np.testing.assert_array_equal(np.asarray(s_final.cache_state),
+                                  np.asarray(a_final.cache_state))
+    check_exact_directory(cfg, s_final)
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_invariants_cross_node_traffic(seed):
+    cfg = SystemConfig.scale(num_nodes=64, max_instrs=32,
+                             drain_depth=4)
+    sys_ = CoherenceSystem.from_workload(cfg, "uniform", trace_len=32,
+                                         seed=seed, local_frac=0.3)
+    st = se.from_sim_state(cfg, sys_.state, seed=seed)
+    # invariant must hold at every chunk boundary, not just at the end
+    for _ in range(6):
+        st = se.run_rounds(cfg, st, 13)
+        check_exact_directory(cfg, st)
+    st = se.run_sync_to_quiescence(cfg, st, 16, 100_000)
+    assert bool(st.quiescent())
+    check_exact_directory(cfg, st)
+    m = st.metrics
+    total = int(jnp.sum(st.instr_count))
+    assert int(m.instrs_retired) == total
+    assert (int(m.read_hits) + int(m.write_hits) + int(m.read_misses)
+            + int(m.write_misses) + int(m.upgrades)) == total
+
+
+def test_adversarial_single_address_contention():
+    """Every node hammers one remote block: one transaction wins per
+    round, the hash rotates winners, and the run still terminates with a
+    consistent directory."""
+    cfg = SystemConfig.reference(num_nodes=8)
+    addr = 0x05
+    traces = [[(1, addr, n + 1), (0, addr, 0)] * 4 for n in range(8)]
+    st = se.from_sim_state(cfg, init_state(cfg, traces))
+    st = se.run_sync_to_quiescence(cfg, st, 8, 50_000)
+    assert bool(st.quiescent())
+    check_exact_directory(cfg, st)
+    assert int(st.metrics.conflicts) > 0  # contention actually happened
+    # final memory value must be one of the written values
+    mem, _, _ = se.to_sim_arrays(cfg, st)
+    assert int(mem[0, 5]) in set(range(1, 9)) | {20 * 0 + 5}
+
+
+def test_seed_determinism_and_schedule_sensitivity():
+    cfg = SystemConfig.scale(num_nodes=32, max_instrs=16)
+    sys_ = CoherenceSystem.from_workload(cfg, "uniform", trace_len=16,
+                                         seed=1, local_frac=0.2)
+
+    def run(seed):
+        st = se.from_sim_state(cfg, sys_.state, seed=seed)
+        return se.run_sync_to_quiescence(cfg, st, 8, 50_000)
+
+    a, b = run(5), run(5)
+    np.testing.assert_array_equal(np.asarray(a.cache_val),
+                                  np.asarray(b.cache_val))
+    np.testing.assert_array_equal(np.asarray(a.dm), np.asarray(b.dm))
+    assert int(a.round) == int(b.round)
+
+
+def test_nop_in_trace_retires():
+    """Malformed trace lines load as in-trace NOPs (utils.trace); they
+    must retire with no effect instead of livelocking the round loop."""
+    cfg = SystemConfig.reference(num_nodes=4)
+    traces = [[(1, 0x03, 9), (2, 0, 0), (0, 0x03, 0)], [], [], []]
+    st = se.from_sim_state(cfg, init_state(cfg, traces))
+    st = se.run_sync_to_quiescence(cfg, st, 4, 2000)
+    assert bool(st.quiescent())
+    assert int(st.metrics.instrs_retired) == 3
+    a_final = run_to_quiescence(cfg, init_state(cfg, traces), 10_000)
+    np.testing.assert_array_equal(np.asarray(st.cache_val),
+                                  np.asarray(a_final.cache_val))
+
+
+def test_non_power_of_two_mem_size():
+    """dm rows are strided by 2**block_bits, so address==row holds even
+    when mem_size is not a power of two (codec packs the home id above
+    ceil(log2(mem_size)) bits)."""
+    cfg = SystemConfig.reference(num_nodes=4, mem_size=12)
+    # node 0 writes (home 1, block 0) = addr 16, reads it back
+    traces = [[(1, 0x10, 77), (0, 0x10, 0)], [], [], []]
+    st = se.from_sim_state(cfg, init_state(cfg, traces))
+    st = se.run_sync_to_quiescence(cfg, st, 4, 2000)
+    assert bool(st.quiescent())
+    check_exact_directory(cfg, st)
+    a_final = run_to_quiescence(cfg, init_state(cfg, traces), 10_000)
+    mem, ds, bv = se.to_sim_arrays(cfg, st)
+    np.testing.assert_array_equal(mem, np.asarray(a_final.memory))
+    np.testing.assert_array_equal(ds, np.asarray(a_final.dir_state))
+    np.testing.assert_array_equal(np.asarray(st.cache_val),
+                                  np.asarray(a_final.cache_val))
+
+
+def test_burst_retires_consecutive_hits_in_one_round():
+    """A node-local all-hit trace retires drain_depth instrs per round
+    after the first fill."""
+    cfg = SystemConfig.reference(num_nodes=4, drain_depth=4)
+    # node 0: one write-miss fill, then 12 hits on the same line
+    traces = [[(1, 0x03, 9)] + [(0, 0x03, 0)] * 12, [], [], []]
+    st = se.from_sim_state(cfg, init_state(cfg, traces))
+    st = se.run_rounds(cfg, st, 1)
+    assert int(st.idx[0]) == 1          # round 1: the miss commits
+    st = se.run_rounds(cfg, st, 1)
+    assert int(st.idx[0]) == 5          # round 2: burst of 4 hits
+    st = se.run_sync_to_quiescence(cfg, st, 4, 1000)
+    assert bool(st.quiescent())
+    assert int(st.metrics.read_hits) == 12

@@ -49,6 +49,13 @@ class SystemConfig:
     # the stall watchdog then flags. 0.0 = off (default, zero cost).
     drop_prob: float = 0.0
 
+    # Hit-burst depth of the synchronous transactional engine
+    # (ops.sync_engine): per round each node retires up to this many
+    # consecutive cache hits locally before attempting one coherence
+    # transaction. Purely a throughput knob — hits are node-local, so any
+    # depth realizes a legal schedule.
+    drain_depth: int = 4
+
     # Admission window (backpressure): maximum number of simultaneously
     # outstanding request transactions system-wide. The reference silently
     # drops on overflow (assignment.c:754-762), which at its dimensions is

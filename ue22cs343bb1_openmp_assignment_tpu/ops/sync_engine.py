@@ -1,0 +1,443 @@
+"""Synchronous transactional engine: whole coherence transactions per round.
+
+The async engine (ops.step) replays the reference's message-level
+semantics cycle by cycle: one dequeue + at most one instruction per node
+per cycle, multi-hop transactions spread over ~4-6 cycles
+(``assignment.c:165-737``). That fidelity is what the parity and race
+suites need — but it pays the device's per-dispatch overhead once per
+*message hop*.
+
+This engine executes the *same protocol* under a different — equally
+legal — schedule: per round, every node first retires a short burst of
+cache hits locally, then at most one full coherence transaction
+(read-miss / write-miss / upgrade, ``assignment.c:654-735``) commits
+**atomically**: all of its hops (request, forward, writeback, fill,
+invalidation fan-out, eviction notice — call stacks SURVEY §3.2-3.5)
+apply in one step, as if every message of the transaction was delivered
+and processed before the next transaction touched the same block. Each
+round realizes one serialization of the winning transactions; the
+arbitration hash (seedable) picks the winners, replacing OS lock order.
+
+Atomicity buys an invariant the async machine only approaches at
+quiescence: **the directory is always exact** — a block's sharer set is
+precisely the set of nodes whose cache currently holds its tag valid
+(evictions commit inside the displacing transaction, so the directory
+never lags a replacement the way in-flight ``EVICT_*`` messages make it
+lag in the reference, ``assignment.c:767-804``). Exactness makes the
+sharer *bitvector* redundant:
+
+* invalidation fan-out (``assignment.c:364-373``) = "kill every valid
+  line holding this tag" — a tag equality test, no sharer set needed;
+* the ``EVICT_SHARED`` last-sharer promotion (``assignment.c:584-587``)
+  target self-identifies by tag match;
+* only the EM owner id (``__builtin_ctz``, ``assignment.c:209``) and
+  the sharer count (``__builtin_popcount``, ``assignment.c:564``) need
+  storing — two int columns instead of ceil(N/32) words per entry.
+  At 4096 nodes this shrinks the directory 32x and removes every
+  bitvector gather from the hot path.
+
+Per-round device work (the whole machine, any N):
+  2 window gathers (instruction burst) + 1 claim scatter-min +
+  3 directory-row gathers + 1 owner-value gather + 2 effect scatters +
+  1 per-line action gather + fused elementwise.
+No sort, no mailbox tensor. Conflicts (two transactions claiming one
+directory entry, or a transaction claiming another's victim entry) are
+resolved by a per-round seeded hash priority: losers simply retry next
+round — the analogue of losing the lock-acquisition race in the
+reference. The hash reshuffles every round, so progress is guaranteed
+(the globally minimal claimant always wins both its entries).
+
+Schedules realized here are a strict subset of the reference's (atomic
+transactions cannot interleave mid-flight), so racy-suite outcomes are
+always *reachable* outcomes of the reference machine; the parity suites
+(tests 1/2: node-local, schedule-independent) produce byte-identical
+golden dumps (tests/test_sync_engine.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ue22cs343bb1_openmp_assignment_tpu import codec
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.state import SimState
+from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState, Op
+
+# dm column layout: the per-(home, block) directory/memory table, one row
+# per entry; entry index == the address itself (addr = home * M + block,
+# codec.py / assignment.c:46-49).
+DM_STATE, DM_COUNT, DM_OWNER, DM_MEM = 0, 1, 2, 3
+DM_COLS = 4
+
+# per-round action codes scattered at a directory entry, applied by every
+# cached line holding that entry's tag (the vectorized stand-in for the
+# INV / WRITEBACK_INT / EVICT_SHARED-promotion fan-outs)
+ACT_NONE, ACT_KILL, ACT_DOWNGRADE, ACT_PROMOTE = 0, 1, 2, 3
+
+
+class SyncMetrics(struct.PyTreeNode):
+    rounds: jnp.ndarray          # [] i32
+    instrs_retired: jnp.ndarray  # [] i32
+    read_hits: jnp.ndarray       # [] i32 (burst-retired)
+    write_hits: jnp.ndarray      # [] i32 (burst-retired, M/E lines)
+    read_misses: jnp.ndarray     # [] i32 (committed RD transactions)
+    write_misses: jnp.ndarray    # [] i32 (committed WR transactions)
+    upgrades: jnp.ndarray        # [] i32 (committed S-write upgrades)
+    conflicts: jnp.ndarray       # [] i32 (transaction attempts that lost)
+    evictions: jnp.ndarray       # [] i32 (conflict replacements committed)
+    invalidations: jnp.ndarray   # [] i32 (lines killed by fan-out)
+    promotions: jnp.ndarray      # [] i32 (S->E last-sharer promotions)
+
+    @classmethod
+    def zeros(cls) -> "SyncMetrics":
+        z = jnp.zeros((), jnp.int32)
+        return cls(rounds=z, instrs_retired=z, read_hits=z, write_hits=z,
+                   read_misses=z, write_misses=z, upgrades=z, conflicts=z,
+                   evictions=z, invalidations=z, promotions=z)
+
+
+class SyncState(struct.PyTreeNode):
+    """Machine state for the transactional engine (no mailboxes).
+
+    Shapes: N nodes, C cache lines, M blocks/node, T trace length."""
+
+    cache_addr: jnp.ndarray   # [N, C] i32 (cfg.invalid_address = empty)
+    cache_val: jnp.ndarray    # [N, C] i32
+    cache_state: jnp.ndarray  # [N, C] i32 CacheState
+
+    # directory + memory, one row per (home, block) entry, flat
+    # [N << block_bits, 4] so that row index == the packed address
+    # (codec.make_address; rows for block >= mem_size are unused holes
+    # when mem_size is not a power of two):
+    # DM_STATE DirState, DM_COUNT sharers, DM_OWNER EM owner id, DM_MEM value
+    dm: jnp.ndarray           # [N << block_bits, DM_COLS] i32
+
+    instr_pack: jnp.ndarray   # [N, T, 2] i32: [op << 28 | addr, value]
+    instr_count: jnp.ndarray  # [N] i32
+    idx: jnp.ndarray          # [N] i32: next instruction to execute
+
+    seed: jnp.ndarray         # [] i32 arbitration seed (schedule knob)
+    round: jnp.ndarray        # [] i32
+    metrics: SyncMetrics
+
+    @property
+    def num_nodes(self) -> int:
+        return self.cache_addr.shape[0]
+
+    def quiescent(self) -> jnp.ndarray:
+        return jnp.all(self.idx >= self.instr_count)
+
+
+def from_sim_state(cfg: SystemConfig, st: SimState, seed: int = 0) -> SyncState:
+    """Adopt a freshly initialized SimState (same loaders/workloads).
+
+    Must be called on a pre-run state (empty mailboxes, cold caches):
+    the engines share initial conditions, not mid-flight state.
+    """
+    N, M = cfg.num_nodes, cfg.mem_size
+    S = 1 << cfg.block_bits          # row stride per home (>= M)
+    dm = jnp.zeros((N * S, DM_COLS), jnp.int32)
+    dm = dm.at[:, DM_STATE].set(jnp.full((N * S,), int(DirState.U),
+                                         jnp.int32))
+    node_rows = jnp.arange(N, dtype=jnp.int32)[:, None] * S
+    blocks = jnp.arange(M, dtype=jnp.int32)[None, :]
+    dm = dm.at[(node_rows + blocks).reshape(-1), DM_MEM].set(
+        st.memory.reshape(N * M))
+    return SyncState(
+        cache_addr=st.cache_addr, cache_val=st.cache_val,
+        cache_state=st.cache_state,
+        dm=dm,
+        instr_pack=jnp.stack(
+            [(st.instr_op << 28) | st.instr_addr, st.instr_val], axis=-1),
+        instr_count=st.instr_count,
+        idx=jnp.zeros((N,), jnp.int32),
+        seed=jnp.asarray(seed, jnp.int32),
+        round=jnp.zeros((), jnp.int32),
+        metrics=SyncMetrics.zeros(),
+    )
+
+
+def to_sim_arrays(cfg: SystemConfig, st: SyncState):
+    """Reconstruct (memory, dir_state, dir_bitvec) in SimState layout.
+
+    The sharer bitvector (reference ``assignment.c:63``) is derived from
+    cache tags — exact, because the transactional engine keeps the
+    directory exact (module docstring). Host-side; used by the golden
+    dumper and the invariant tests.
+    """
+    import numpy as np
+    N, C, M, W = (cfg.num_nodes, cfg.cache_size, cfg.mem_size,
+                  cfg.bitvec_words)
+    S = 1 << cfg.block_bits
+    dm = np.asarray(st.dm).reshape(N, S, DM_COLS)[:, :M]
+    memory = dm[:, :, DM_MEM]
+    dir_state = dm[:, :, DM_STATE]
+    bv = np.zeros((N, M, W), np.uint32)
+    ca = np.asarray(st.cache_addr)
+    cs = np.asarray(st.cache_state)
+    for n in range(N):
+        for c in range(C):
+            if cs[n, c] != int(CacheState.INVALID):
+                a = int(ca[n, c])
+                home = a >> cfg.block_bits
+                block = a & ((1 << cfg.block_bits) - 1)
+                if 0 <= home < N:
+                    bv[home, block, n // 32] |= np.uint32(1 << (n % 32))
+    return memory, dir_state, bv
+
+
+def to_dump_view(cfg: SystemConfig, st: SyncState):
+    """A SimState-shaped view for utils.golden.state_to_dumps."""
+    import types as _t
+    memory, dir_state, bv = to_sim_arrays(cfg, st)
+    return _t.SimpleNamespace(
+        memory=memory, dir_state=dir_state, dir_bitvec=bv,
+        cache_addr=st.cache_addr, cache_val=st.cache_val,
+        cache_state=st.cache_state)
+
+
+def _mix(x: jnp.ndarray) -> jnp.ndarray:
+    """murmur3-style 32-bit finalizer (deterministic arbitration hash)."""
+    x = jnp.asarray(x, jnp.uint32)
+    x ^= x >> 16
+    x *= jnp.uint32(0x7FEB352D)
+    x ^= x >> 15
+    x *= jnp.uint32(0x846CA68B)
+    x ^= x >> 16
+    return x
+
+
+def round_step(cfg: SystemConfig, st: SyncState) -> SyncState:
+    """Advance every node by one burst of hits plus one transaction."""
+    N, C, M = cfg.num_nodes, cfg.cache_size, cfg.mem_size
+    T = st.instr_pack.shape[1]
+    H = cfg.drain_depth
+    E = N << cfg.block_bits          # dm rows; row index == packed address
+    rows = jnp.arange(N, dtype=jnp.int32)
+    INV = int(CacheState.INVALID)
+
+    ca, cv, cs = st.cache_addr, st.cache_val, st.cache_state
+    idx0 = st.idx
+
+    # ---- instruction window: burst of up to H hits + the stopped instr ---
+    # ONE flat gather for the whole window and both fields (idx advances
+    # by at most 1 per burst step, so H+1 lookahead always suffices)
+    offs = jnp.arange(H + 1, dtype=jnp.int32)[None, :]          # [1, H+1]
+    w_idx = idx0[:, None] + offs                                 # [N, H+1]
+    w_live = w_idx < st.instr_count[:, None]
+    w_flat = rows[:, None] * T + jnp.minimum(w_idx, T - 1)
+    w = st.instr_pack.reshape(N * T, 2)[w_flat]                  # [N, H+1, 2]
+    w_oa, w_val = w[..., 0], w[..., 1]
+
+    # ---- phase 1: hit burst (node-local, no cross-node effects) ----------
+    # Vectorized over the whole window at once: within a burst only hits
+    # execute, and hits never change any line's tag or hit/miss class
+    # (a write hit needs M/E and leaves M — still a write hit; values
+    # change, classifications don't). So every window position can be
+    # classified against the round-start cache, and the burst length is
+    # the length of the leading all-hit prefix.
+    w_op, w_addr = w_oa >> 28, w_oa & 0x0FFFFFFF                 # [N, H+1]
+    w_ci = codec.cache_index(cfg, w_addr)
+    c_iota = jnp.arange(C, dtype=jnp.int32)
+    w_onehot = w_ci[:, :, None] == c_iota[None, None, :]         # [N,H+1,C]
+    pick3 = lambda arr: jnp.sum(
+        jnp.where(w_onehot, arr[:, None, :], 0), axis=2)         # [N, H+1]
+    wl_addr, wl_state = pick3(ca), pick3(cs)
+    w_tagok = (wl_addr == w_addr) & (wl_state != INV)
+    w_rdhit = w_live & (w_op == int(Op.READ)) & w_tagok
+    w_wrhit = w_live & (w_op == int(Op.WRITE)) & w_tagok & (
+        (wl_state == int(CacheState.MODIFIED))
+        | (wl_state == int(CacheState.EXCLUSIVE)))
+    # in-trace NOPs (malformed trace lines, utils.trace) retire with no
+    # effect, like the reference's fall-through on unknown type
+    w_nop = w_live & (w_op == int(Op.NOP))
+    w_hit = w_rdhit | w_wrhit | w_nop
+    # leading all-hit prefix over the first H positions (the H+1-th slot
+    # is only ever the transaction candidate)
+    prefix = jnp.cumprod(w_hit[:, :H].astype(jnp.int32), axis=1)  # [N, H]
+    d = jnp.sum(prefix, axis=1)                                   # [N] <= H
+    in_burst = prefix.astype(bool)                                # [N, H]
+    rh = jnp.sum(w_rdhit[:, :H] & in_burst, dtype=jnp.int32)
+    wh = jnp.sum(w_wrhit[:, :H] & in_burst, dtype=jnp.int32)
+    # burst write effects per line: last write in the burst wins; any
+    # write leaves the line MODIFIED (static H-step fold, all fused)
+    for k in range(H):
+        wmask = (w_wrhit[:, k] & in_burst[:, k])[:, None] & w_onehot[:, k]
+        cv = jnp.where(wmask, w_val[:, k][:, None], cv)
+        cs = jnp.where(wmask, int(CacheState.MODIFIED), cs)
+
+    # ---- phase 2: classify the stopped instruction as a transaction ------
+    d_onehot = offs == d[:, None]                                 # [N, H+1]
+    pick = lambda arr: jnp.sum(jnp.where(d_onehot, arr, 0), axis=1)
+    oa = pick(w_oa)
+    val = pick(w_val)
+    live = jnp.sum(jnp.where(d_onehot, w_live, False), axis=1).astype(bool)
+    op, addr = oa >> 28, oa & 0x0FFFFFFF
+    ci = codec.cache_index(cfg, addr)
+    onehot_ci = ci[:, None] == c_iota[None, :]                    # [N, C]
+    pickc = lambda arr: jnp.sum(jnp.where(onehot_ci, arr, 0), axis=1)
+    l_addr, l_val, l_state = pickc(ca), pickc(cv), pickc(cs)
+    tag_ok = (l_addr == addr) & (l_state != INV)
+    is_rd, is_wr = op == int(Op.READ), op == int(Op.WRITE)
+    upg = live & is_wr & tag_ok & (l_state == int(CacheState.SHARED))
+    rd_miss = live & is_rd & ~tag_ok
+    wr_miss = live & is_wr & ~tag_ok
+    txn = rd_miss | wr_miss | upg
+    # (a leftover *hit* at the stop position just waits for next round's
+    # burst — happens only when the burst budget H was exhausted)
+
+    e1 = jnp.clip(addr, 0, E - 1)                    # entry index == address
+    has_victim = txn & ~tag_ok & (l_state != INV) & (l_addr != addr)
+    # (upgrade has tag_ok, so no victim; invalid line: no victim — matches
+    # handleCacheReplacement's INVALID no-op, assignment.c:771-775)
+    e2 = jnp.clip(l_addr, 0, E - 1)
+
+    # ---- conflict resolution: seeded-hash priority, scatter-min ----------
+    h = _mix(rows.astype(jnp.uint32)
+             ^ (st.round.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
+             ^ (st.seed.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)))
+    key = ((h % jnp.uint32(8191)).astype(jnp.int32)) * N + rows  # unique
+    claim = jnp.full((E,), jnp.iinfo(jnp.int32).max, jnp.int32)
+    c_idx = jnp.concatenate([jnp.where(txn, e1, E),
+                             jnp.where(has_victim, e2, E)])
+    claim = claim.at[c_idx].min(jnp.concatenate([key, key]), mode="drop")
+    got = claim[jnp.stack([e1, e2], axis=1)]                      # [N, 2]
+    win = txn & (got[:, 0] == key) & (~has_victim | (got[:, 1] == key))
+
+    # ---- gather directory rows + owner value -----------------------------
+    dm1 = st.dm[e1]                                               # [N, 4]
+    dm2 = st.dm[e2]
+    d1s, d1c, d1o, d1m = dm1[:, 0], dm1[:, 1], dm1[:, 2], dm1[:, 3]
+    d_u = d1s == int(DirState.U)
+    d_s = d1s == int(DirState.S)
+    d_em = d1s == int(DirState.EM)
+    # EM owner's current copy (the value WRITEBACK_INT/INV would flush,
+    # assignment.c:268,486) — post-burst, so same-round local writes by
+    # the owner are visible, matching hits-before-transactions order
+    safe_o = jnp.clip(d1o, 0, N - 1)
+    val_o = cv.reshape(-1)[safe_o * C + ci]
+
+    # ---- transaction outcomes (SURVEY §3.2-3.5 collapsed) ----------------
+    rd_w, wr_w, up_w = win & rd_miss, win & wr_miss, win & upg
+    wlike = wr_w | up_w
+    # primary entry update
+    n1s = jnp.where(wlike, int(DirState.EM),
+                    jnp.where(rd_w & d_u, int(DirState.EM),
+                              int(DirState.S)))
+    n1c = jnp.where(wlike | (rd_w & d_u), 1,
+                    jnp.where(rd_w & d_em, 2, d1c + 1))
+    n1o = jnp.where(wlike | (rd_w & d_u), rows, d1o)
+    n1m = jnp.where((rd_w | wr_w) & d_em, val_o, d1m)
+    act1 = jnp.where(wlike, ACT_KILL,
+                     jnp.where(rd_w & d_em, ACT_DOWNGRADE, ACT_NONE))
+    # victim entry update (EVICT_SHARED / EVICT_MODIFIED semantics,
+    # assignment.c:538-617)
+    ev = win & has_victim
+    ev_mod = ev & (l_state == int(CacheState.MODIFIED))
+    ev_sh = ev & ~ev_mod
+    d2c, d2m = dm2[:, 1], dm2[:, 3]
+    n2c = jnp.where(ev_mod, 0, d2c - 1)
+    n2s = jnp.where(n2c == 0, int(DirState.U),
+                    jnp.where(n2c == 1, int(DirState.EM), int(DirState.S)))
+    n2m = jnp.where(ev_mod, l_val, d2m)
+    n2o = dm2[:, 2]  # updated by the promoted line's own scatter below
+    act2 = jnp.where(ev_sh & (n2c == 1), ACT_PROMOTE, ACT_NONE)
+
+    # ---- commit: one packed scatter for both entries ---------------------
+    t_idx = jnp.concatenate([jnp.where(win, e1, E), jnp.where(ev, e2, E)])
+    t_dm = jnp.concatenate([
+        jnp.stack([n1s, n1c, n1o, n1m], axis=1),
+        jnp.stack([n2s, n2c, n2o, n2m], axis=1)], axis=0)
+    dm = st.dm.at[t_idx].set(t_dm, mode="drop")
+    # action table (transient, rebuilt every round)
+    acts = jnp.full((E,), ACT_NONE, jnp.int32)
+    a_val = jnp.concatenate([act1 * N + rows, act2 * N + rows])
+    acts = acts.at[jnp.where(
+        jnp.concatenate([win & (act1 != ACT_NONE), ev & (act2 != ACT_NONE)]),
+        t_idx, E)].set(a_val, mode="drop")
+
+    # ---- per-line fan-out application ------------------------------------
+    # every valid line looks up the action at its own tag's entry; the
+    # entry index IS the tag, so a hit is automatically tag-matched
+    line_e = jnp.clip(ca, 0, E - 1)                               # [N, C]
+    line_act = acts[line_e]                                       # [N, C]
+    a_code, a_req = line_act // N, line_act % N
+    valid = cs != INV
+    not_self = a_req != rows[:, None]
+    kill = valid & not_self & (a_code == ACT_KILL)
+    down = valid & not_self & (a_code == ACT_DOWNGRADE)
+    promo = valid & not_self & (a_code == ACT_PROMOTE)
+    cs = jnp.where(kill, INV,
+                   jnp.where(down, int(CacheState.SHARED),
+                             jnp.where(promo, int(CacheState.EXCLUSIVE),
+                                       cs)))
+    # each promoted line reports itself as its entry's new EM owner
+    # (per-line, not per-node: one node can be promoted on several lines
+    # in one round when distinct evictions each leave it as last sharer)
+    dm = dm.at[jnp.where(promo, line_e, E).reshape(-1), DM_OWNER].set(
+        jnp.broadcast_to(rows[:, None], (N, C)).reshape(-1), mode="drop")
+
+    # ---- winner fills its own line ---------------------------------------
+    fill_state = jnp.where(
+        rd_w, jnp.where(d_u, int(CacheState.EXCLUSIVE),
+                        int(CacheState.SHARED)),
+        int(CacheState.MODIFIED))
+    fill_val = jnp.where(rd_w, jnp.where(d_em, val_o, d1m), val)
+    onehot = (jnp.arange(C, dtype=jnp.int32)[None, :] == ci[:, None])
+    fmask = onehot & win[:, None]
+    ca = jnp.where(fmask, addr[:, None], ca)
+    cv = jnp.where(fmask, fill_val[:, None], cv)
+    cs = jnp.where(fmask, fill_state[:, None], cs)
+
+    # ---- bookkeeping -----------------------------------------------------
+    new_idx = idx0 + d + win.astype(jnp.int32)
+    mt = st.metrics
+    metrics = mt.replace(
+        rounds=mt.rounds + 1,
+        instrs_retired=mt.instrs_retired
+        + jnp.sum(d, dtype=jnp.int32) + jnp.sum(win, dtype=jnp.int32),
+        read_hits=mt.read_hits + rh,
+        write_hits=mt.write_hits + wh,
+        read_misses=mt.read_misses + jnp.sum(rd_w, dtype=jnp.int32),
+        write_misses=mt.write_misses + jnp.sum(wr_w, dtype=jnp.int32),
+        upgrades=mt.upgrades + jnp.sum(up_w, dtype=jnp.int32),
+        conflicts=mt.conflicts + jnp.sum(txn & ~win, dtype=jnp.int32),
+        evictions=mt.evictions + jnp.sum(ev, dtype=jnp.int32),
+        invalidations=mt.invalidations + jnp.sum(kill, dtype=jnp.int32),
+        promotions=mt.promotions + jnp.sum(promo, dtype=jnp.int32),
+    )
+    return st.replace(cache_addr=ca, cache_val=cv, cache_state=cs, dm=dm,
+                      idx=new_idx, round=st.round + 1, metrics=metrics)
+
+
+# -- runners ---------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def run_rounds(cfg: SystemConfig, st: SyncState, n: int) -> SyncState:
+    def body(s, _):
+        return round_step(cfg, s), None
+    st, _ = jax.lax.scan(body, st, None, length=n)
+    return st
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2, 3))
+def run_sync_to_quiescence(cfg: SystemConfig, st: SyncState,
+                           chunk: int = 32,
+                           max_rounds: int = 100_000) -> SyncState:
+    """Run until every trace is fully retired (chunked single dispatch)."""
+
+    def body(s, _):
+        return round_step(cfg, s), None
+
+    def cond(s):
+        return (~s.quiescent()) & (s.round < max_rounds)
+
+    def chunk_body(s):
+        s, _ = jax.lax.scan(body, s, None, length=chunk)
+        return s
+
+    return jax.lax.while_loop(cond, chunk_body, st)
