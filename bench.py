@@ -31,8 +31,12 @@ def main():
                     help="cycles/rounds per timed device call")
     ap.add_argument("--workload", default="uniform")
     ap.add_argument("--local-frac", type=float, default=0.8)
-    ap.add_argument("--drain-depth", type=int, default=8,
+    ap.add_argument("--drain-depth", type=int, default=16,
                     help="sync engine: hit-burst length per round")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="sync engine: independent machines batched into "
+                         "one ensemble (different workload + arbitration "
+                         "seeds); throughput is aggregated")
     ap.add_argument("--admission", type=int, default=None,
                     help="async engine: max concurrent outstanding "
                          "requests (None = reference drop semantics)")
@@ -58,8 +62,11 @@ def main():
                              admission_window=args.admission,
                              drain_depth=args.drain_depth)
     gen_kw = {"local_frac": args.local_frac} if args.workload == "uniform" else {}
-    sys_ = CoherenceSystem.from_workload(
-        cfg, args.workload, trace_len=args.trace_len, seed=0, **gen_kw)
+
+    def make_system(seed):
+        return CoherenceSystem.from_workload(
+            cfg, args.workload, trace_len=args.trace_len, seed=seed,
+            **gen_kw)
 
     # The whole run is ONE device dispatch (chunked scan inside a
     # while_loop): on a high-latency device link every eager op is a
@@ -72,8 +79,22 @@ def main():
     # device plugin block_until_ready can return before the computation
     # finishes, which silently turns the measurement into dispatch time
     # and inflates throughput by orders of magnitude.
-    if args.engine == "sync":
-        st0 = se.from_sim_state(cfg, sys_.state, seed=0)
+    if args.engine != "sync" and args.replicas > 1:
+        print("error: --replicas needs --engine sync", file=sys.stderr)
+        return 2
+    if args.engine == "sync" and args.replicas > 1:
+        reps = [se.from_sim_state(cfg, make_system(r).state, seed=r)
+                for r in range(args.replicas)]
+        st0 = se.make_ensemble(reps)
+
+        def run():
+            return se.run_ensemble_to_quiescence(cfg, st0, args.chunk,
+                                                 max_cycles)
+
+        def steps(st):
+            return int(st.metrics.rounds[0])
+    elif args.engine == "sync":
+        st0 = se.from_sim_state(cfg, make_system(0).state, seed=0)
 
         def run():
             return se.run_sync_to_quiescence(cfg, st0, args.chunk,
@@ -82,6 +103,8 @@ def main():
         def steps(st):
             return int(st.metrics.rounds)
     else:
+        sys_ = make_system(0)
+
         def run():
             return run_chunked_to_quiescence(cfg, sys_.state, args.chunk,
                                              max_cycles)
@@ -89,26 +112,37 @@ def main():
         def steps(st):
             return int(st.metrics.cycles)
 
-    int(run().metrics.instrs_retired)
+    import numpy as np
+
+    def total_retired(st):
+        return int(np.sum(np.asarray(st.metrics.instrs_retired)))
+
+    total_retired(run())              # warmup; device_get = real sync
 
     t0 = time.perf_counter()
     state = run()
-    retired = int(state.metrics.instrs_retired)   # device_get = real sync
+    retired = total_retired(state)    # device_get = real sync
     elapsed = time.perf_counter() - t0
     value = retired / elapsed
+    rep = (f", {args.replicas} replicas" if args.replicas > 1 else "")
     result = {
         "metric": f"simulated RD/WR instrs/sec @{args.nodes} cores "
-                  f"({args.engine} engine, {args.workload}, 1 chip, "
+                  f"({args.engine} engine, {args.workload}{rep}, 1 chip, "
                   f"{jax.devices()[0].platform})",
         "value": round(value, 1),
         "unit": "instrs/sec",
         "vs_baseline": round(value / 1e8, 4),
     }
+    if args.engine == "sync" and args.replicas > 1:
+        quiet = bool(np.all(np.asarray(
+            jax.vmap(lambda x: x.quiescent())(state))))
+    else:
+        quiet = bool(state.quiescent())
     extra = {
         "engine": args.engine,
         "steps": steps(state),
         "retired": retired,
-        "quiescent": bool(state.quiescent()),
+        "quiescent": quiet,
         "elapsed_s": round(elapsed, 3),
     }
     if args.engine == "async":

@@ -104,6 +104,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "assignment.c:179-182)")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend (default: first device)")
+    p.add_argument("--engine", choices=["async", "sync"], default="async",
+                   help="async = message-level engine (reference network "
+                        "semantics, schedule knobs, fault injection); "
+                        "sync = transactional engine (atomic coherence "
+                        "rounds, the throughput path — see PERF.md)")
+    p.add_argument("--drain-depth", type=int, default=None,
+                   help="sync engine: hit-burst length per round")
     return p
 
 
@@ -122,11 +129,105 @@ def _schedule_knobs(args, num_nodes: int) -> dict:
     return kw
 
 
+def _main_sync(args) -> int:
+    """--engine sync: the transactional engine's CLI path."""
+    from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+    from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
+    from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+    from ue22cs343bb1_openmp_assignment_tpu.utils import checkpoint as ckpt
+    from ue22cs343bb1_openmp_assignment_tpu.utils.golden import write_dumps
+
+    for flag, why in (("delays", "message-level issue schedules"),
+                      ("periods", "message-level issue schedules"),
+                      ("drop_prob", "message-drop fault injection"),
+                      ("trace_log", "message/instruction event tracing"),
+                      ("admission", "mailbox backpressure")):
+        if getattr(args, flag):
+            print(f"error: --{flag.replace('_', '-')} needs the mailbox "
+                  f"network ({why}); use --engine async", file=sys.stderr)
+            return 2
+
+    seed = args.arb_seed if args.arb_seed is not None else 0
+    if args.resume:
+        cfg, st, meta = ckpt.load_checkpoint(args.resume)
+        if meta.get("kind") != "sync":
+            print("error: checkpoint was written by the async engine; "
+                  "resume it without --engine sync", file=sys.stderr)
+            return 2
+        if args.drain_depth is not None:
+            # pure compute knob (burst window; no state shapes depend on
+            # it) — overridable on resume like the async path's
+            # admission/drop knobs
+            import dataclasses as _dc
+            cfg = _dc.replace(cfg, drain_depth=args.drain_depth)
+        if args.arb_seed is not None:
+            st = st.replace(seed=np.int32(args.arb_seed))
+    else:
+        dims = dict(num_nodes=args.nodes)
+        if args.drain_depth is not None:
+            dims["drain_depth"] = args.drain_depth
+        if args.workload:
+            cfg = SystemConfig.scale(
+                queue_capacity=args.queue_capacity or 64, **dims)
+            system = CoherenceSystem.from_workload(
+                cfg, args.workload, trace_len=args.trace_len,
+                seed=args.seed)
+        elif args.test_dir:
+            cfg = SystemConfig.reference(**dims)
+            path = os.path.join(args.tests_root, args.test_dir)
+            try:
+                system = CoherenceSystem.from_test_dir(path, cfg)
+            except FileNotFoundError as e:
+                print(e, file=sys.stderr)
+                return 1
+            for n in range(cfg.num_nodes):
+                print(f"Processor {n} initialized")  # assignment.c:850
+        else:
+            print("error: provide <test_directory> or --workload",
+                  file=sys.stderr)
+            return 2
+        st = se.from_sim_state(cfg, system.state, seed=seed)
+
+    if args.run_cycles is not None:
+        from ue22cs343bb1_openmp_assignment_tpu.ops.sync_engine import (
+            run_rounds)
+        st = run_rounds(cfg, st, args.run_cycles)
+    else:
+        st = se.run_sync_to_quiescence(cfg, st, 16, args.max_cycles)
+    if args.save_checkpoint:
+        ckpt.save_checkpoint(args.save_checkpoint, cfg, st)
+    if args.run_cycles is None and not bool(st.quiescent()):
+        print(f"warning: not quiescent after {args.max_cycles} rounds "
+              "(conflict retries still pending; raise --max-cycles)",
+              file=sys.stderr)
+    if args.check or args.check_strict:
+        try:
+            report = se.check_exact_directory(cfg, st)
+        except AssertionError as e:
+            print(f"invariant check FAILED: {e}", file=sys.stderr)
+            return 3
+        print(f"invariant check passed (exact directory); report: "
+              f"{json.dumps(report)}", file=sys.stderr)
+    if args.test_dir or args.dump:
+        write_dumps(cfg, se.to_dump_view(cfg, st), args.out_dir)
+    if args.metrics:
+        m = {f: int(getattr(st.metrics, f))
+             for f in ("rounds", "instrs_retired", "read_hits",
+                       "write_hits", "read_misses", "write_misses",
+                       "upgrades", "conflicts", "evictions",
+                       "invalidations", "promotions")}
+        print(json.dumps(m), file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
+
+    if args.engine == "sync":
+        return _main_sync(args)
 
     from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
     from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
@@ -141,7 +242,15 @@ def main(argv=None) -> int:
 
     if args.resume:
         import dataclasses as _dc
-        system = CoherenceSystem.load(args.resume)
+        try:
+            system = CoherenceSystem.load(args.resume)
+        except ValueError as e:
+            if "SyncState" in str(e) or "instr_pack" in str(e):
+                print("error: checkpoint was written by the transactional "
+                      "engine; resume it with --engine sync",
+                      file=sys.stderr)
+                return 2
+            raise
         cfg = system.cfg
         if args.nodes != cfg.num_nodes and (args.delays or args.periods):
             print("error: --delays/--periods with --resume need --nodes to "

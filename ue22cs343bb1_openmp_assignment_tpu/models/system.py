@@ -199,5 +199,10 @@ class CoherenceSystem:
     @classmethod
     def load(cls, path: str) -> "CoherenceSystem":
         """Resume from a checkpoint; bit-exact continuation."""
-        cfg, state, _ = checkpoint.load_checkpoint(path)
+        cfg, state, meta = checkpoint.load_checkpoint(path)
+        if meta.get("kind", "sim") != "sim":
+            raise ValueError(
+                f"{path} holds a SyncState (transactional engine) "
+                "checkpoint; load it with ops.sync_engine / "
+                "--engine sync")
         return cls(cfg, state)

@@ -31,11 +31,15 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
 
 
 def state_shardings(cfg, mesh: Mesh, state):
-    """NamedShardings for a SimState pytree: shard axis 0 when it is the
-    node axis, replicate everything else."""
+    """NamedShardings for a machine-state pytree (SimState or SyncState):
+    shard axis 0 when it is the node axis — or node-major like the
+    transactional engine's flat directory table ([N << block_bits, ...],
+    whose leading axis partitions into per-home runs) — replicate
+    everything else."""
+    node_major = (cfg.num_nodes, cfg.num_nodes << cfg.block_bits)
 
     def spec(x):
-        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == cfg.num_nodes:
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] in node_major:
             return NamedSharding(mesh, P(AXIS, *([None] * (x.ndim - 1))))
         return NamedSharding(mesh, P())
 

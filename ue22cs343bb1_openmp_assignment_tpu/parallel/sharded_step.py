@@ -43,3 +43,14 @@ def make_sharded_runner(cfg: SystemConfig, mesh, example_state,
         return s
 
     return run
+
+
+def make_sharded_round(cfg: SystemConfig, mesh, example_state):
+    """jit one transactional-engine round (ops.sync_engine) with
+    node-axis shardings: caches/traces partition by node, the flat
+    directory table partitions into per-home runs, and GSPMD lowers the
+    claim scatter-min / directory gathers into cross-shard collectives."""
+    from ue22cs343bb1_openmp_assignment_tpu.ops.sync_engine import round_step
+    sh = state_shardings(cfg, mesh, example_state)
+    return jax.jit(lambda s: round_step(cfg, s), in_shardings=(sh,),
+                   out_shardings=sh)

@@ -19,7 +19,9 @@ Format: a single ``.npz`` (zip of npy arrays) with
   (``metrics.cycles``, ``cache_state``, ...),
 * ``__config__``: the SystemConfig as JSON (shapes are config-derived,
   so a checkpoint is self-describing),
-* ``__meta__``: user metadata + a format version.
+* ``__meta__``: user metadata + a format version + the state kind
+  ("sim" = async message-level engine, "sync" = transactional engine;
+  both engines' states are plain pytrees, so one format serves both).
 
 No framework dependency: numpy only. The state is an ordinary pytree,
 so orbax users can equally hand ``state`` to
@@ -41,6 +43,18 @@ from ue22cs343bb1_openmp_assignment_tpu.state import Metrics, SimState
 
 FORMAT_VERSION = 2  # v2: + waiting_since, fault_key, injected-drop metric
 
+
+def _state_classes(kind: str):
+    if kind == "sync":
+        from ue22cs343bb1_openmp_assignment_tpu.ops.sync_engine import (
+            SyncMetrics, SyncState)
+        return SyncState, SyncMetrics
+    return SimState, Metrics
+
+
+def _state_kind(state) -> str:
+    return "sync" if type(state).__name__ == "SyncState" else "sim"
+
 _CONFIG_KEY = "__config__"
 _META_KEY = "__meta__"
 
@@ -55,14 +69,17 @@ def _leaf_dict(state: SimState) -> dict:
     return flat
 
 
-def save_checkpoint(path: str, cfg: SystemConfig, state: SimState,
+def save_checkpoint(path: str, cfg: SystemConfig, state,
                     meta: Optional[dict] = None) -> None:
-    """Write a self-describing checkpoint of (cfg, state) to ``path``."""
+    """Write a self-describing checkpoint of (cfg, state) to ``path``.
+
+    ``state`` may be a SimState (async engine) or SyncState
+    (transactional engine); the kind is recorded and restored."""
     arrays = _leaf_dict(state)
     arrays[_CONFIG_KEY] = np.frombuffer(
         json.dumps(dataclasses.asdict(cfg)).encode(), dtype=np.uint8)
     arrays[_META_KEY] = np.frombuffer(
-        json.dumps({**(meta or {}),
+        json.dumps({**(meta or {}), "kind": _state_kind(state),
                     "format_version": FORMAT_VERSION}).encode(),
         dtype=np.uint8)
     with open(path, "wb") as f:
@@ -89,6 +106,7 @@ def load_checkpoint(path: str) -> Tuple[SystemConfig, SimState, dict]:
             f"checkpoint format {meta.get('format_version')} != "
             f"supported {FORMAT_VERSION}")
     cfg = SystemConfig(**cfg_d)
+    state_cls, metrics_cls = _state_classes(meta.get("kind", "sim"))
 
     metric_fields = {}
     state_fields = {}
@@ -97,16 +115,16 @@ def load_checkpoint(path: str) -> Tuple[SystemConfig, SimState, dict]:
             metric_fields[name.split(".", 1)[1]] = arr
         else:
             state_fields[name] = arr
-    expected = set(f.name for f in dataclasses.fields(SimState))
+    expected = set(f.name for f in dataclasses.fields(state_cls))
     got = set(state_fields) | {"metrics"}
     if got != expected:
         raise ValueError(f"checkpoint fields {sorted(got)} != "
                          f"state fields {sorted(expected)}")
-    state = SimState(metrics=Metrics(**metric_fields), **state_fields)
+    state = state_cls(metrics=metrics_cls(**metric_fields), **state_fields)
     return cfg, state, meta
 
 
-def checkpoint_bytes(state: SimState) -> int:
+def checkpoint_bytes(state) -> int:
     """Total checkpoint payload size (useful for scale planning).
 
     Computed from shapes/dtypes only — no device→host transfer.
