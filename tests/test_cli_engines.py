@@ -1,0 +1,84 @@
+"""CLI engine selection: async (default), sync, native.
+
+All three execution paths must serve the reference-compat contract:
+byte-exact core_<n>_output.txt dumps on a deterministic suite, metrics
+on demand, and clean errors for engine/feature mismatches.
+"""
+
+import json
+import os
+
+import pytest
+
+from tests.conftest import REFERENCE_TESTS, requires_reference
+
+from ue22cs343bb1_openmp_assignment_tpu import cli
+
+
+def run_cli(args, tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    rc = cli.main(args)
+    out, err = capsys.readouterr()
+    return rc, out, err
+
+
+@requires_reference
+@pytest.mark.parametrize("engine", ["async", "sync", "native"])
+def test_engines_byte_exact_on_test_1(engine, tmp_path, monkeypatch,
+                                      capsys):
+    rc, _, err = run_cli(
+        ["test_1", "--tests-root", REFERENCE_TESTS, "--cpu",
+         "--engine", engine, "--metrics"], tmp_path, monkeypatch, capsys)
+    assert rc == 0
+    metrics = json.loads(err.strip().splitlines()[-1])
+    assert metrics["instrs_retired"] == 68
+    for n in range(4):
+        got = (tmp_path / f"core_{n}_output.txt").read_text()
+        golden = open(
+            f"{REFERENCE_TESTS}/test_1/core_{n}_output.txt").read()
+        assert got == golden, f"{engine} core_{n} diverged"
+
+
+def test_sync_rejects_async_only_flags(tmp_path, monkeypatch, capsys):
+    rc, _, err = run_cli(
+        ["--workload", "uniform", "--cpu", "--engine", "sync",
+         "--delays", "1", "2", "3", "4"], tmp_path, monkeypatch, capsys)
+    assert rc == 2 and "--engine async" in err
+
+
+def test_native_rejects_jax_only_flags(tmp_path, monkeypatch, capsys):
+    rc, _, err = run_cli(
+        ["--workload", "uniform", "--cpu", "--engine", "native",
+         "--check"], tmp_path, monkeypatch, capsys)
+    assert rc == 2 and "--engine async" in err
+
+
+def test_missing_dir_clean_exit(tmp_path, monkeypatch, capsys):
+    for engine in ("async", "sync", "native"):
+        rc, _, err = run_cli(
+            ["no_such_dir", "--tests-root", REFERENCE_TESTS, "--cpu",
+             "--engine", engine], tmp_path, monkeypatch, capsys)
+        assert rc == 1, engine
+
+
+def test_native_workload_long_trace(tmp_path, monkeypatch, capsys):
+    """--trace-len beyond the default 32 must size the native engine's
+    trace storage (regression: out-of-bounds reads)."""
+    rc, _, err = run_cli(
+        ["--workload", "uniform", "--nodes", "8", "--trace-len", "64",
+         "--cpu", "--engine", "native", "--metrics"],
+        tmp_path, monkeypatch, capsys)
+    assert rc == 0
+    metrics = json.loads(err.strip().splitlines()[-1])
+    assert metrics["instrs_retired"] == 8 * 64
+
+
+def test_native_nodes_beyond_fixture_errors(tmp_path, monkeypatch,
+                                            capsys):
+    """--nodes larger than the fixture's core files fails loudly (like
+    the async path / assignment.c:826-829), not silently half-loaded."""
+    rc, _, err = run_cli(
+        ["test_1", "--tests-root", REFERENCE_TESTS, "--cpu",
+         "--engine", "native", "--nodes", "8"],
+        tmp_path, monkeypatch, capsys)
+    assert rc == 1 and "core_4" in err

@@ -97,3 +97,37 @@ def test_sharded_sync_round_bit_identical():
                     jax.tree_util.tree_leaves(ref)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     se.check_exact_directory(cfg, out)
+
+
+def test_multihost_mesh_bit_identical():
+    """A 2-D (hosts, nodes) mesh — DCN outer, ICI inner — folds the node
+    axis over both axes; results match the single-device run for both
+    engines."""
+    import numpy as np
+    from ue22cs343bb1_openmp_assignment_tpu.models.system import (
+        CoherenceSystem)
+    from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+    from ue22cs343bb1_openmp_assignment_tpu.ops.step import run_cycles
+    from ue22cs343bb1_openmp_assignment_tpu.parallel import (
+        make_multihost_mesh, make_sharded_round, make_sharded_runner,
+        shard_state)
+
+    cfg = SystemConfig.scale(num_nodes=32, max_instrs=8, drain_depth=4,
+                             queue_capacity=16)
+    sys_ = CoherenceSystem.from_workload(cfg, "uniform", trace_len=8,
+                                         seed=5, local_frac=0.3)
+    mesh = make_multihost_mesh(num_hosts=2, devices=jax.devices()[:8])
+    assert mesh.devices.shape == (2, 4)
+
+    sharded = shard_state(cfg, mesh, sys_.state)
+    out = make_sharded_runner(cfg, mesh, sharded, 16)(sharded)
+    ref = run_cycles(cfg, sys_.state, 16)
+    np.testing.assert_array_equal(np.asarray(out.cache_val),
+                                  np.asarray(ref.cache_val))
+
+    st = se.from_sim_state(cfg, sys_.state)
+    sh = shard_state(cfg, mesh, st)
+    round_fn = make_sharded_round(cfg, mesh, sh)
+    out2 = round_fn(round_fn(sh))
+    ref2 = se.run_rounds(cfg, st, 2)
+    np.testing.assert_array_equal(np.asarray(out2.dm), np.asarray(ref2.dm))

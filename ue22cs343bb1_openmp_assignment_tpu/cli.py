@@ -104,14 +104,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "assignment.c:179-182)")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend (default: first device)")
-    p.add_argument("--engine", choices=["async", "sync"], default="async",
-                   help="async = message-level engine (reference network "
-                        "semantics, schedule knobs, fault injection); "
-                        "sync = transactional engine (atomic coherence "
-                        "rounds, the throughput path — see PERF.md)")
+    p.add_argument("--engine", choices=["async", "sync", "native"],
+                   default="async",
+                   help="async = message-level JAX engine (reference "
+                        "network semantics, schedule knobs, fault "
+                        "injection); sync = transactional JAX engine "
+                        "(atomic coherence rounds, the throughput path — "
+                        "see PERF.md); native = host-side C++ engine with "
+                        "async semantics (the differential oracle)")
     p.add_argument("--drain-depth", type=int, default=None,
                    help="sync engine: hit-burst length per round")
     return p
+
+
+def _arb_rank(seed: int, num_nodes: int) -> np.ndarray:
+    """--arb-seed → arbitration permutation; the single definition keeps
+    the JAX and native engines seed-for-seed comparable."""
+    return np.argsort(
+        np.random.RandomState(seed).rand(num_nodes)).astype(np.int32)
 
 
 def _schedule_knobs(args, num_nodes: int) -> dict:
@@ -123,9 +133,7 @@ def _schedule_knobs(args, num_nodes: int) -> dict:
     if args.periods:
         kw["issue_period"] = np.asarray(args.periods, np.int32)
     if args.arb_seed is not None:
-        kw["arb_rank"] = np.argsort(
-            np.random.RandomState(args.arb_seed).rand(num_nodes)
-        ).astype(np.int32)
+        kw["arb_rank"] = _arb_rank(args.arb_seed, num_nodes)
     return kw
 
 
@@ -220,6 +228,82 @@ def _main_sync(args) -> int:
     return 0
 
 
+def _main_native(args) -> int:
+    """--engine native: the C++ oracle as an execution backend.
+
+    Same observable semantics as the async JAX engine (message-level
+    cycles, schedule knobs); host-only, no device."""
+    import types as _t
+
+    from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+    from ue22cs343bb1_openmp_assignment_tpu.native.bindings import NativeEngine
+    from ue22cs343bb1_openmp_assignment_tpu.utils.golden import write_dumps
+    from ue22cs343bb1_openmp_assignment_tpu.utils.trace import load_test_dir
+
+    for flag, why in (("drop_prob", "fault injection"),
+                      ("trace_log", "event tracing"),
+                      ("admission", "admission gating"),
+                      ("save_checkpoint", "checkpointing"),
+                      ("resume", "checkpointing"),
+                      ("check", "vectorized invariant checking"),
+                      ("check_strict", "vectorized invariant checking")):
+        if getattr(args, flag):
+            print(f"error: --{flag.replace('_', '-')} ({why}) is a JAX-"
+                  "engine feature; use --engine async", file=sys.stderr)
+            return 2
+
+    if args.workload:
+        from ue22cs343bb1_openmp_assignment_tpu.models import workloads
+        cfg = SystemConfig.scale(num_nodes=args.nodes,
+                                 max_instrs=args.trace_len,
+                                 queue_capacity=args.queue_capacity or 256)
+        import jax as _jax
+        arrs = workloads.GENERATORS[args.workload](
+            _jax.random.PRNGKey(args.seed), cfg, args.trace_len)
+        eng = NativeEngine(cfg)
+        eng.load_instr_arrays(*(np.asarray(a) for a in arrs))
+    elif args.test_dir:
+        cfg = SystemConfig.reference(num_nodes=args.nodes)
+        path = os.path.join(args.tests_root, args.test_dir)
+        try:
+            traces = load_test_dir(path, cfg.num_nodes, cfg.max_instrs)
+        except FileNotFoundError as e:
+            print(e, file=sys.stderr)
+            return 1
+        eng = NativeEngine(cfg)
+        eng.load_traces(traces)
+        for n in range(cfg.num_nodes):
+            print(f"Processor {n} initialized")  # assignment.c:850
+    else:
+        print("error: provide <test_directory> or --workload",
+              file=sys.stderr)
+        return 2
+
+    if args.delays or args.periods:
+        for knob in ("delays", "periods"):
+            vals = getattr(args, knob)
+            if vals and len(vals) != cfg.num_nodes:
+                print(f"error: --{knob} needs one value per node "
+                      f"(got {len(vals)}, --nodes is {cfg.num_nodes})",
+                      file=sys.stderr)
+                return 2
+        eng.set_schedule(args.delays or None, args.periods or None)
+    if args.arb_seed is not None:
+        eng.set_arbitration(_arb_rank(args.arb_seed, cfg.num_nodes))
+
+    eng.run(args.run_cycles if args.run_cycles is not None
+            else args.max_cycles)
+    if args.run_cycles is None and not eng.quiescent:
+        print(f"warning: not quiescent after {args.max_cycles} cycles",
+              file=sys.stderr)
+    if args.test_dir or args.dump:
+        write_dumps(cfg, _t.SimpleNamespace(**eng.export_state()),
+                    args.out_dir)
+    if args.metrics:
+        print(json.dumps(eng.metrics()), file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.cpu:
@@ -228,6 +312,8 @@ def main(argv=None) -> int:
 
     if args.engine == "sync":
         return _main_sync(args)
+    if args.engine == "native":
+        return _main_native(args)
 
     from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
     from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem

@@ -3,7 +3,7 @@
 Exposes :class:`NativeEngine`, semantically identical to the JAX engine
 (ops/step.cycle): same cycle model, arbitration, schedule knobs, and
 protocol quirks — the host-side oracle for differential fuzzing and the
-CLI's `--backend=native` path.
+CLI's `--engine native` path.
 """
 
 from __future__ import annotations

@@ -7,7 +7,7 @@
 // same arbitration rules, same quirks), used for:
 //   * differential fuzzing of the JAX/Pallas path on random workloads,
 //   * fast host-side schedule search for the racy golden suites,
-//   * a `--backend=native` execution path in the CLI.
+//   * a `--engine native` execution path in the CLI.
 //
 // Deliberately NOT the reference's architecture: no OpenMP threads, no
 // locks, no spinning. One deterministic scheduler steps every node
@@ -355,7 +355,6 @@ class Engine {
         fill(node, line, msg.addr, msg.value,
              msg.dirstate == kS ? kShared : kExclusive);
         waiting_[node] = 0;
-        retire(node);
         break;
       }
       case kWritebackInt: {  // at old owner: flush to home (+requester)
@@ -382,7 +381,6 @@ class Engine {
             evict_notice(node, line);
           fill(node, line, msg.addr, msg.value, kShared);
         }
-        if (waiting_[node]) retire(node);
         waiting_[node] = 0;  // unconditional (quirk 2)
         break;
       }
@@ -421,7 +419,6 @@ class Engine {
           evict_notice(node, line);
         fill(node, line, msg.addr, cur_val_[node], kModified);  // quirk 1
         waiting_[node] = 0;
-        retire(node);
         break;
       }
       case kInv: {  // at sharer
@@ -466,7 +463,6 @@ class Engine {
         evict_notice(node, line);  // unconditional call, no tag check
         fill(node, line, msg.addr, cur_val_[node], kModified);
         waiting_[node] = 0;
-        retire(node);
         break;
       }
       case kWritebackInv: {  // at old owner
@@ -490,7 +486,6 @@ class Engine {
             evict_notice(node, line);
           fill(node, line, msg.addr, cur_val_[node], kModified);
         }
-        if (waiting_[node]) retire(node);
         waiting_[node] = 0;  // unconditional (quirk 2)
         break;
       }
@@ -556,8 +551,11 @@ class Engine {
     if (sends) admitted_this_cycle_++;
     instr_idx_[node] = i;
     cur_val_[node] = val;  // latch (quirk 1 source)
+    // count at issue, like the JAX frontend's `issued` (every issued
+    // instruction eventually completes; counting at unblock instead
+    // double-counts under the premature-unblock quirk, SURVEY quirk 2)
+    metrics_.instrs_retired++;
     if (op == kNop) {
-      metrics_.instrs_retired++;
       return;
     }
     Message msg;
@@ -567,7 +565,6 @@ class Engine {
     if (op == kRead) {
       if (hit) {
         metrics_.read_hits++;
-        metrics_.instrs_retired++;
       } else {
         metrics_.read_misses++;
         msg.type = kReadRequest;
@@ -578,7 +575,6 @@ class Engine {
       if (hit && (cs(node, line) == kModified ||
                   cs(node, line) == kExclusive)) {
         metrics_.write_hits++;
-        metrics_.instrs_retired++;
         cv(node, line) = val;
         cs(node, line) = kModified;
       } else if (hit) {  // SHARED write hit -> upgrade
@@ -596,11 +592,6 @@ class Engine {
         waiting_[node] = 1;
       }
     }
-  }
-
-  void retire(int32_t /*node*/) {
-    // a blocked instruction completes when its reply unblocks the node
-    metrics_.instrs_retired++;
   }
 
   const int32_t n_, c_, m_, q_, t_, words_;

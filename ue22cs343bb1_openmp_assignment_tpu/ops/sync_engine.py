@@ -240,6 +240,7 @@ def check_exact_directory(cfg: SystemConfig, st: SyncState) -> dict:
     is_u = d_state == int(DirState.U)
     is_em = d_state == int(DirState.EM)
     is_s = d_state == int(DirState.S)
+    assert np.all(is_u | is_em | is_s), "directory row with corrupt state"
     block_ok = (np.arange(E) & (S - 1)) < M   # real rows (no stride holes)
     assert np.all(is_u[~block_ok] | (holders[~block_ok] == 0)), (
         "stride-hole entry is claimed")

@@ -22,12 +22,38 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS = "nodes"
+DCN_AXIS = "hosts"
 
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """A 1-D mesh over `devices` (default: all) with axis 'nodes'."""
     devices = list(devices if devices is not None else jax.devices())
     return Mesh(devices, (AXIS,))
+
+
+def make_multihost_mesh(num_hosts: Optional[int] = None,
+                        devices: Optional[Sequence[jax.Device]] = None
+                        ) -> Mesh:
+    """A 2-D (hosts, nodes) mesh: DCN over the outer axis, ICI inner.
+
+    The simulated-node axis shards over *both* axes (see
+    :func:`state_shardings`): contiguous node ranges stay within a host
+    (collectives for intra-host traffic ride ICI), and only messages
+    whose receiver lives on another host cross DCN. On a real multi-host
+    slice call ``jax.distributed.initialize()`` first and pass nothing —
+    the process/host structure comes from ``jax.devices()``; for
+    single-process validation pass ``num_hosts`` to fold a flat device
+    list into a virtual host dimension.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if num_hosts is None:
+        num_hosts = max(1, jax.process_count())
+    if len(devices) % num_hosts:
+        raise ValueError(
+            f"{len(devices)} devices do not fold into {num_hosts} hosts")
+    import numpy as np
+    grid = np.array(devices).reshape(num_hosts, -1)
+    return Mesh(grid, (DCN_AXIS, AXIS))
 
 
 def state_shardings(cfg, mesh: Mesh, state):
@@ -37,10 +63,14 @@ def state_shardings(cfg, mesh: Mesh, state):
     whose leading axis partitions into per-home runs) — replicate
     everything else."""
     node_major = (cfg.num_nodes, cfg.num_nodes << cfg.block_bits)
+    # on a (hosts, nodes) mesh the node axis shards over both axes:
+    # outer = DCN (host boundary), inner = ICI
+    axes = tuple(a for a in (DCN_AXIS, AXIS) if a in mesh.axis_names)
+    lead = axes if len(axes) > 1 else axes[0]
 
     def spec(x):
         if getattr(x, "ndim", 0) >= 1 and x.shape[0] in node_major:
-            return NamedSharding(mesh, P(AXIS, *([None] * (x.ndim - 1))))
+            return NamedSharding(mesh, P(lead, *([None] * (x.ndim - 1))))
         return NamedSharding(mesh, P())
 
     return jax.tree.map(spec, state)
