@@ -1,0 +1,114 @@
+"""Trace streaming: unbounded workloads in bounded memory.
+
+The reference caps runs at 32 instructions per node (assignment.c:10);
+continue_with_traces chains max_instrs-sized phases through a quiescent
+machine. Chaining inserts a quiescence barrier, which is itself a legal
+schedule of the concatenated trace — so schedule-independent (node-
+local) workloads must end byte-identical to one long run.
+"""
+
+import numpy as np
+import pytest
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
+from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+from ue22cs343bb1_openmp_assignment_tpu.ops.step import run_to_quiescence
+from ue22cs343bb1_openmp_assignment_tpu.state import (continue_with_traces,
+                                                      init_state)
+
+
+def local_traces(rng, cfg, n_instrs):
+    out = []
+    for n in range(cfg.num_nodes):
+        tr = []
+        for _ in range(n_instrs):
+            a = (n << cfg.block_bits) | int(rng.integers(cfg.mem_size))
+            if rng.random() < 0.5:
+                tr.append((0, a, 0))
+            else:
+                tr.append((1, a, int(rng.integers(256))))
+        out.append(tr)
+    return out
+
+
+def test_async_chained_phases_equal_one_run():
+    cfg = SystemConfig.reference(num_nodes=4, max_instrs=16)
+    rng = np.random.default_rng(11)
+    p1 = local_traces(rng, cfg, 16)
+    p2 = local_traces(rng, cfg, 16)
+
+    st = run_to_quiescence(cfg, init_state(cfg, p1), 20_000)
+    st = continue_with_traces(cfg, st, traces=p2)
+    st = run_to_quiescence(cfg, st, 20_000)
+    assert bool(st.quiescent())
+
+    cfg_long = SystemConfig.reference(num_nodes=4, max_instrs=32)
+    concat = [a + b for a, b in zip(p1, p2)]
+    ref = run_to_quiescence(cfg_long, init_state(cfg_long, concat), 20_000)
+    for f in ("cache_addr", "cache_val", "cache_state", "memory",
+              "dir_state", "dir_bitvec"):
+        np.testing.assert_array_equal(np.asarray(getattr(st, f)),
+                                      np.asarray(getattr(ref, f)), f)
+
+
+def test_sync_chained_phases_equal_one_run():
+    cfg = SystemConfig.reference(num_nodes=4, max_instrs=16)
+    rng = np.random.default_rng(13)
+    p1 = local_traces(rng, cfg, 16)
+    p2 = local_traces(rng, cfg, 16)
+
+    st = se.from_sim_state(cfg, init_state(cfg, p1))
+    st = se.run_sync_to_quiescence(cfg, st, 8, 20_000)
+    st = se.continue_with_traces(cfg, st, traces=p2)
+    st = se.run_sync_to_quiescence(cfg, st, 8, 20_000)
+    assert bool(st.quiescent())
+    se.check_exact_directory(cfg, st)
+    assert int(st.metrics.instrs_retired) == 4 * 32
+
+    cfg_long = SystemConfig.reference(num_nodes=4, max_instrs=32)
+    concat = [a + b for a, b in zip(p1, p2)]
+    ref = se.run_sync_to_quiescence(
+        cfg_long, se.from_sim_state(cfg_long, init_state(cfg_long, concat)),
+        8, 20_000)
+    for f in ("cache_addr", "cache_val", "cache_state"):
+        np.testing.assert_array_equal(np.asarray(getattr(st, f)),
+                                      np.asarray(getattr(ref, f)), f)
+    mem_a, ds_a, bv_a = se.to_sim_arrays(cfg, st)
+    mem_b, ds_b, bv_b = se.to_sim_arrays(cfg_long, ref)
+    np.testing.assert_array_equal(mem_a, mem_b)
+    np.testing.assert_array_equal(ds_a, ds_b)
+    np.testing.assert_array_equal(bv_a, bv_b)
+
+
+def test_cross_node_streaming_invariants():
+    """Racy cross-node phases: chained outcome is a legal (barriered)
+    schedule — retire counts and invariants must hold."""
+    cfg = SystemConfig.scale(num_nodes=32, max_instrs=16)
+    st = se.from_sim_state(
+        cfg, CoherenceSystem.from_workload(
+            cfg, "uniform", trace_len=16, seed=0, local_frac=0.2).state)
+    total = 0
+    for phase_seed in range(3):
+        st = se.run_sync_to_quiescence(cfg, st, 16, 50_000)
+        assert bool(st.quiescent())
+        se.check_exact_directory(cfg, st)
+        total += 32 * 16
+        assert int(st.metrics.instrs_retired) == total
+        nxt = CoherenceSystem.from_workload(
+            cfg, "uniform", trace_len=16, seed=phase_seed + 1,
+            local_frac=0.2).state
+        st = se.continue_with_traces(
+            cfg, st, instr_arrays=(nxt.instr_op, nxt.instr_addr,
+                                   nxt.instr_val, nxt.instr_count))
+
+
+def test_not_quiescent_rejected():
+    cfg = SystemConfig.reference(num_nodes=4)
+    traces = [[(1, 0x15, 9)], [], [], []]  # cross-node write, needs hops
+    st = init_state(cfg, traces)
+    with pytest.raises(ValueError, match="quiescent"):
+        continue_with_traces(cfg, st, traces=traces)
+    ss = se.from_sim_state(cfg, st)
+    with pytest.raises(ValueError, match="retired"):
+        se.continue_with_traces(cfg, ss, traces=traces)

@@ -70,12 +70,20 @@ from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState, Op
 # dm column layout: the per-(home, block) directory/memory table, one row
 # per entry; entry index == the address itself (addr = home * M + block,
 # codec.py / assignment.c:46-49).
-DM_STATE, DM_COUNT, DM_OWNER, DM_MEM, DM_ACT, DM_REQ = 0, 1, 2, 3, 4, 5
-DM_COLS = 6
+DM_STATE, DM_COUNT, DM_OWNER, DM_MEM, DM_ACT, DM_REQ, DM_CLAIM = (
+    0, 1, 2, 3, 4, 5, 6)
+DM_COLS = 7
 # DM_ACT holds (round << 2) | action — the fan-out action table lives in
 # the directory row itself; a row whose embedded round differs from the
 # current round carries no action, so stale actions self-invalidate and
 # the table needs no per-round reset.
+#
+# DM_CLAIM holds the conflict-resolution scatter-min key. Keys embed
+# (max_round - round) in their high bits, so every round's keys compare
+# strictly below all stale keys from earlier rounds — the claim column
+# never needs resetting either. Consequence: a run is bounded to
+# claim_max_rounds(cfg) rounds (2^30 key bits split between the round
+# countdown and a node-unique priority); the runners assert the bound.
 
 # per-round action codes scattered at a directory entry, applied by every
 # cached line holding that entry's tag (the vectorized stand-in for the
@@ -113,12 +121,14 @@ class SyncState(struct.PyTreeNode):
     cache_val: jnp.ndarray    # [N, C] i32
     cache_state: jnp.ndarray  # [N, C] i32 CacheState
 
-    # directory + memory + per-round fan-out action, one row per
-    # (home, block) entry, flat [N << block_bits, 6] so that row index ==
-    # the packed address (codec.make_address; rows for block >= mem_size
-    # are unused holes when mem_size is not a power of two):
-    # DM_STATE DirState, DM_COUNT sharers, DM_OWNER EM owner id,
-    # DM_MEM value, DM_ACT round-tagged action, DM_REQ requester/evictor
+    # directory + memory + per-round fan-out action + claim key, one row
+    # per (home, block) entry, flat [N << block_bits, 7] so that row
+    # index == the packed address (codec.make_address; rows for
+    # block >= mem_size are unused holes when mem_size is not a power of
+    # two): DM_STATE DirState, DM_COUNT sharers, DM_OWNER EM owner id,
+    # DM_MEM value, DM_ACT round-tagged action, DM_REQ requester/evictor,
+    # DM_CLAIM arbitration key (monotone-decreasing per round; preserve
+    # across save/restore, reset only at phase boundaries)
     dm: jnp.ndarray           # [N << block_bits, DM_COLS] i32
 
     instr_pack: jnp.ndarray   # [N, T, 2] i32: [op << 28 | addr, value]
@@ -149,8 +159,11 @@ def from_sim_state(cfg: SystemConfig, st: SimState, seed: int = 0) -> SyncState:
     dm = dm.at[:, DM_STATE].set(jnp.full((N * S,), int(DirState.U),
                                          jnp.int32))
     # fresh machines start at round 0; pre-stamp DM_ACT with an
-    # impossible round tag so round 0 sees no stale actions
+    # impossible round tag so round 0 sees no stale actions, and the
+    # claim column above every reachable key
     dm = dm.at[:, DM_ACT].set(jnp.full((N * S,), -4, jnp.int32))
+    dm = dm.at[:, DM_CLAIM].set(
+        jnp.full((N * S,), jnp.iinfo(jnp.int32).max, jnp.int32))
     node_rows = jnp.arange(N, dtype=jnp.int32)[:, None] * S
     blocks = jnp.arange(M, dtype=jnp.int32)[None, :]
     dm = dm.at[(node_rows + blocks).reshape(-1), DM_MEM].set(
@@ -198,6 +211,32 @@ def to_sim_arrays(cfg: SystemConfig, st: SyncState):
     return memory, dir_state, bv
 
 
+def continue_with_traces(cfg: SystemConfig, st: SyncState, traces=None,
+                         instr_arrays=None) -> SyncState:
+    """Stream the next trace phase into a retired machine.
+
+    Transactional-engine twin of state.continue_with_traces: caches,
+    the directory table and metrics persist; the instruction stream
+    resets. Requires every current trace to be fully retired."""
+    if not bool(st.quiescent()):
+        raise ValueError(
+            "continue_with_traces needs a fully retired machine")
+    from ue22cs343bb1_openmp_assignment_tpu.state import build_instr_arrays
+    op, addr, val, count = build_instr_arrays(
+        cfg, traces=traces, instr_arrays=instr_arrays)
+    # phase boundary: reset the round counter and the round-tagged
+    # claim/action columns, so the claim-key budget and action-tag
+    # namespace are per phase (metrics stay cumulative)
+    dm = st.dm.at[:, DM_CLAIM].set(jnp.iinfo(jnp.int32).max)
+    dm = dm.at[:, DM_ACT].set(-4)
+    return st.replace(
+        dm=dm,
+        instr_pack=jnp.stack([(op << 28) | addr, val], axis=-1),
+        instr_count=count,
+        idx=jnp.zeros((cfg.num_nodes,), jnp.int32),
+        round=jnp.zeros((), jnp.int32))
+
+
 def to_dump_view(cfg: SystemConfig, st: SyncState):
     """A SimState-shaped view for utils.golden.state_to_dumps."""
     import types as _t
@@ -206,6 +245,12 @@ def to_dump_view(cfg: SystemConfig, st: SyncState):
         memory=memory, dir_state=dir_state, dir_bitvec=bv,
         cache_addr=st.cache_addr, cache_val=st.cache_val,
         cache_state=st.cache_state)
+
+
+def claim_max_rounds(cfg: SystemConfig) -> int:
+    """Hard bound on rounds per machine (DM_CLAIM key-packing budget)."""
+    prio_bits = max(1, (cfg.num_nodes - 1).bit_length())
+    return (1 << (30 - prio_bits)) - 1
 
 
 def check_exact_directory(cfg: SystemConfig, st: SyncState) -> dict:
@@ -366,20 +411,35 @@ def round_step(cfg: SystemConfig, st: SyncState) -> SyncState:
     e2 = jnp.clip(l_addr, 0, E - 1)
 
     # ---- conflict resolution: seeded-hash priority, scatter-min ----------
-    h = _mix(rows.astype(jnp.uint32)
-             ^ (st.round.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
+    # per-round priority permutation: an affine-xorshift bijection on
+    # prio_bits bits (odd multiplier => bijective mod 2^b; xorshift is
+    # invertible), reseeded every round — pairwise-fair arbitration, the
+    # stand-in for OS lock order. Injective on node ids, so keys are
+    # unique.
+    prio_bits = max(1, (N - 1).bit_length())
+    mask = jnp.uint32((1 << prio_bits) - 1)
+    h = _mix((st.round.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
              ^ (st.seed.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)))
-    key = ((h % jnp.uint32(8191)).astype(jnp.int32)) * N + rows  # unique
-    claim = jnp.full((E,), jnp.iinfo(jnp.int32).max, jnp.int32)
+    x = rows.astype(jnp.uint32)
+    x = (x * ((h << 1) | jnp.uint32(1)) + (h >> 7)) & mask
+    x ^= x >> max(1, prio_bits // 2)
+    x = (x * jnp.uint32(0x9E3779B9 | 1)) & mask
+    prio = x.astype(jnp.int32)
+    # decreasing round countdown in the high bits (DM_CLAIM comment);
+    # clamped so overrunning the budget degrades to stale-claim stalls,
+    # never int32 wraparound
+    countdown = jnp.maximum(claim_max_rounds(cfg) - st.round, 0)
+    key = (countdown << prio_bits) | prio
     c_idx = jnp.concatenate([jnp.where(txn, e1, E),
                              jnp.where(has_victim, e2, E)])
-    claim = claim.at[c_idx].min(jnp.concatenate([key, key]), mode="drop")
-    got = claim[jnp.stack([e1, e2], axis=1)]                      # [N, 2]
-    win = txn & (got[:, 0] == key) & (~has_victim | (got[:, 1] == key))
+    dm_claimed = st.dm.at[c_idx, DM_CLAIM].min(
+        jnp.concatenate([key, key]), mode="drop")
 
     # ---- gather directory rows + owner value -----------------------------
-    dm12 = st.dm[jnp.stack([e1, e2], axis=1)]                     # [N, 2, 6]
+    dm12 = dm_claimed[jnp.stack([e1, e2], axis=1)]                # [N, 2, 7]
     dm1, dm2 = dm12[:, 0], dm12[:, 1]
+    got = dm12[:, :, DM_CLAIM]                                    # [N, 2]
+    win = txn & (got[:, 0] == key) & (~has_victim | (got[:, 1] == key))
     d1s, d1c, d1o, d1m = dm1[:, 0], dm1[:, 1], dm1[:, 2], dm1[:, 3]
     d_u = d1s == int(DirState.U)
     d_s = d1s == int(DirState.S)
@@ -422,16 +482,19 @@ def round_step(cfg: SystemConfig, st: SyncState) -> SyncState:
     # action; untouched rows keep an older round tag = no action
     rtag = st.round << 2
     t_idx = jnp.concatenate([jnp.where(win, e1, E), jnp.where(ev, e2, E)])
+    # claim col re-written with the winner's own key — by construction
+    # the current minimum, so the full-row set is exact
     t_dm = jnp.concatenate([
-        jnp.stack([n1s, n1c, n1o, n1m, rtag | act1, rows], axis=1),
-        jnp.stack([n2s, n2c, n2o, n2m, rtag | act2, rows], axis=1)], axis=0)
-    dm = st.dm.at[t_idx].set(t_dm, mode="drop")
+        jnp.stack([n1s, n1c, n1o, n1m, rtag | act1, rows, key], axis=1),
+        jnp.stack([n2s, n2c, n2o, n2m, rtag | act2, rows, key], axis=1)],
+        axis=0)
+    dm = dm_claimed.at[t_idx].set(t_dm, mode="drop")
 
     # ---- per-line fan-out application ------------------------------------
     # every valid line looks up the action at its own tag's entry; the
     # entry index IS the tag, so a hit is automatically tag-matched
     line_e = jnp.clip(ca, 0, E - 1)                               # [N, C]
-    line_dm = dm[line_e]                                          # [N, C, 6]
+    line_dm = dm[line_e]                                          # [N, C, 7]
     fresh = (line_dm[..., DM_ACT] >> 2) == st.round
     a_code = jnp.where(fresh, line_dm[..., DM_ACT] & 3, ACT_NONE)
     a_req = line_dm[..., DM_REQ]
@@ -507,14 +570,19 @@ def run_ensemble_to_quiescence(cfg: SystemConfig, st: SyncState,
                                chunk: int = 32,
                                max_rounds: int = 100_000) -> SyncState:
     """Run an [R, ...] ensemble until every replica's traces retire."""
+    assert max_rounds < claim_max_rounds(cfg), (
+        f"max_rounds {max_rounds} exceeds the claim-key budget "
+        f"{claim_max_rounds(cfg)} at {cfg.num_nodes} nodes")
     vround = jax.vmap(lambda s: round_step(cfg, s))
 
     def body(s, _):
         return vround(s), None
 
+    limit = st.round[0] + max_rounds
+
     def cond(s):
         return jnp.any(~jax.vmap(lambda x: x.quiescent())(s)) & (
-            s.round[0] < max_rounds)
+            s.round[0] < limit)
 
     def chunk_body(s):
         s, _ = jax.lax.scan(body, s, None, length=chunk)
@@ -527,6 +595,10 @@ def run_ensemble_to_quiescence(cfg: SystemConfig, st: SyncState,
 
 @functools.partial(jax.jit, static_argnums=(0, 2))
 def run_rounds(cfg: SystemConfig, st: SyncState, n: int) -> SyncState:
+    assert n < claim_max_rounds(cfg), (
+        f"{n} rounds exceeds the claim-key budget "
+        f"{claim_max_rounds(cfg)} at {cfg.num_nodes} nodes")
+
     def body(s, _):
         return round_step(cfg, s), None
     st, _ = jax.lax.scan(body, st, None, length=n)
@@ -538,12 +610,19 @@ def run_sync_to_quiescence(cfg: SystemConfig, st: SyncState,
                            chunk: int = 32,
                            max_rounds: int = 100_000) -> SyncState:
     """Run until every trace is fully retired (chunked single dispatch)."""
+    assert max_rounds < claim_max_rounds(cfg), (
+        f"max_rounds {max_rounds} exceeds the claim-key budget "
+        f"{claim_max_rounds(cfg)} at {cfg.num_nodes} nodes")
 
     def body(s, _):
         return round_step(cfg, s), None
 
+    limit = st.round + max_rounds     # per-call budget (chained phases
+                                      # reset `round`, see
+                                      # continue_with_traces)
+
     def cond(s):
-        return (~s.quiescent()) & (s.round < max_rounds)
+        return (~s.quiescent()) & (s.round < limit)
 
     def chunk_body(s):
         s, _ = jax.lax.scan(body, s, None, length=chunk)

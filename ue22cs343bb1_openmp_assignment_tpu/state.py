@@ -162,33 +162,8 @@ def init_state(cfg: SystemConfig, traces=None, issue_delay=None,
     mem_init = (20 * node_ids[:, None]
                 + jnp.arange(M, dtype=jnp.int32)[None, :]) & 0xFF
 
-    instr_op = jnp.full((N, T), int(Op.NOP), jnp.int32)
-    instr_addr = jnp.zeros((N, T), jnp.int32)
-    instr_val = jnp.zeros((N, T), jnp.int32)
-    instr_count = jnp.zeros((N,), jnp.int32)
-    if instr_arrays is not None:
-        instr_op, instr_addr, instr_val, instr_count = (
-            jnp.asarray(a, jnp.int32) for a in instr_arrays)
-        T = instr_op.shape[1]
-        if T != cfg.max_instrs:
-            raise ValueError(
-                f"instr_arrays trace length {T} != cfg.max_instrs "
-                f"{cfg.max_instrs}")
-    elif traces is not None:
-        import numpy as np
-        op_h = np.full((N, T), int(Op.NOP), np.int32)
-        ad_h = np.zeros((N, T), np.int32)
-        va_h = np.zeros((N, T), np.int32)
-        cnt_h = np.zeros((N,), np.int32)
-        for n, tr in enumerate(traces):
-            tr = tr[:T]
-            cnt_h[n] = len(tr)
-            for i, (op, addr, val) in enumerate(tr):
-                op_h[n, i] = int(op)
-                ad_h[n, i] = int(addr)
-                va_h[n, i] = int(val) & 0xFF
-        instr_op, instr_addr = jnp.asarray(op_h), jnp.asarray(ad_h)
-        instr_val, instr_count = jnp.asarray(va_h), jnp.asarray(cnt_h)
+    instr_op, instr_addr, instr_val, instr_count = build_instr_arrays(
+        cfg, traces=traces, instr_arrays=instr_arrays)
 
     if issue_delay is None:
         issue_delay = jnp.zeros((N,), jnp.int32)
@@ -228,6 +203,70 @@ def init_state(cfg: SystemConfig, traces=None, issue_delay=None,
         cycle=jnp.zeros((), jnp.int32),
         metrics=Metrics.zeros(),
     )
+
+
+def build_instr_arrays(cfg: SystemConfig, traces=None, instr_arrays=None):
+    """(op, addr, val, count) [N, T] arrays from traces / prebuilt arrays.
+
+    The single trace-to-arrays path shared by init_state and the
+    streaming continue_with_traces of both engines."""
+    N, T = cfg.num_nodes, cfg.max_instrs
+    if instr_arrays is not None:
+        instr_op, instr_addr, instr_val, instr_count = (
+            jnp.asarray(a, jnp.int32) for a in instr_arrays)
+        if instr_op.shape[1] != T:
+            raise ValueError(
+                f"instr_arrays trace length {instr_op.shape[1]} != "
+                f"cfg.max_instrs {T}")
+        return instr_op, instr_addr, instr_val, instr_count
+    if traces is not None:
+        import numpy as np
+        op_h = np.full((N, T), int(Op.NOP), np.int32)
+        ad_h = np.zeros((N, T), np.int32)
+        va_h = np.zeros((N, T), np.int32)
+        cnt_h = np.zeros((N,), np.int32)
+        for n, tr in enumerate(traces):
+            tr = tr[:T]
+            cnt_h[n] = len(tr)
+            for i, (op, addr, val) in enumerate(tr):
+                op_h[n, i] = int(op)
+                ad_h[n, i] = int(addr)
+                va_h[n, i] = int(val) & 0xFF
+        return (jnp.asarray(op_h), jnp.asarray(ad_h), jnp.asarray(va_h),
+                jnp.asarray(cnt_h))
+    return (jnp.full((N, T), int(Op.NOP), jnp.int32),
+            jnp.zeros((N, T), jnp.int32), jnp.zeros((N, T), jnp.int32),
+            jnp.zeros((N,), jnp.int32))
+
+
+def continue_with_traces(cfg: SystemConfig, state: SimState, traces=None,
+                         instr_arrays=None) -> SimState:
+    """Stream the next trace phase into a quiescent machine.
+
+    The reference caps every run at 32 instructions per node
+    (``assignment.c:10``); here arbitrarily long workloads run in
+    bounded memory by chaining phases: run to quiescence, swap in the
+    next ``max_instrs``-sized chunk, continue. Caches, memories,
+    directories and metrics persist; only the instruction stream resets.
+
+    Because the machine is quiescent between phases, every chained
+    schedule is a legal schedule of the concatenated trace (all phase-k
+    messages drain before any phase-k+1 instruction issues), so on
+    schedule-independent workloads the final state is byte-identical to
+    one long run (tests/test_streaming.py).
+
+    Raises ValueError if the machine is not quiescent (in-flight
+    messages or blocked nodes would interleave with the new phase).
+    """
+    if not bool(state.quiescent()):
+        raise ValueError(
+            "continue_with_traces needs a quiescent machine: messages "
+            "in flight or nodes blocked (run to quiescence first)")
+    op, addr, val, count = build_instr_arrays(
+        cfg, traces=traces, instr_arrays=instr_arrays)
+    return state.replace(
+        instr_op=op, instr_addr=addr, instr_val=val, instr_count=count,
+        instr_idx=jnp.full((cfg.num_nodes,), -1, jnp.int32))
 
 
 def fault_key_from_seed(seed: int) -> jnp.ndarray:
