@@ -77,6 +77,9 @@ def main():
     # network round trip, so host-side polling would dominate the
     # measurement.
     max_cycles = 200 * args.trace_len
+    if args.engine == "sync":
+        # stay inside the claim-key round budget at very large N
+        max_cycles = min(max_cycles, se.claim_max_rounds(cfg) - 1)
 
     # warmup: compile + run the full workload once (discarded); sync via
     # device_get (int()), NOT jax.block_until_ready — over a tunneled

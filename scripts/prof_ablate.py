@@ -35,53 +35,7 @@ def timeit(fn, state):
 full = timeit(step.run_cycles, state)
 print(f"full cycle:          {full/K*1e6:9.1f} us/cycle  ({K} cycles in {full:.3f}s)")
 
-# 2. delivery with no sort (identity order) — measures the argsort cost
-orig_deliver = mailbox.deliver
-
-def deliver_nosort(cfg, state, cand, arb_rank, new_head, new_count):
-    N_, S = cfg.num_nodes, cfg.out_slots
-    F = N_ * S
-    c_type = cand.type.reshape(F)
-    recv = cand.recv.reshape(F)
-    valid = (c_type != 0) & (recv >= 0) & (recv < N_)
-    order = jnp.arange(F)
-    r_s, v_s = recv, valid
-    idx = jnp.arange(F, dtype=jnp.int32)
-    is_start = jnp.concatenate([jnp.array([True]), (r_s[1:] != r_s[:-1]) | ~v_s[1:]])
-    seg_start = mailbox.jax_cummax(jnp.where(is_start, idx, -1))
-    rank = idx - seg_start
-    safe_r = jnp.where(v_s, r_s, 0)
-    free = (cfg.queue_capacity - new_count)[safe_r]
-    accept = v_s & (rank < free)
-    dropped = jnp.sum(v_s & ~accept).astype(jnp.int32)
-    pos = (new_head[safe_r] + new_count[safe_r] + rank) % cfg.queue_capacity
-    tgt_r = jnp.where(accept, r_s, N_)
-    tgt_p = jnp.where(accept, pos, 0)
-
-    def put(arr, field):
-        vals = field.reshape(F) if field.ndim == 2 else field.reshape(F, -1)
-        return arr.at[tgt_r, tgt_p].set(vals, mode="drop")
-
-    updates = dict(
-        mb_type=put(state.mb_type, cand.type),
-        mb_sender=put(state.mb_sender, cand.sender),
-        mb_addr=put(state.mb_addr, cand.addr),
-        mb_value=put(state.mb_value, cand.value),
-        mb_second=put(state.mb_second, cand.second),
-        mb_dirstate=put(state.mb_dirstate, cand.dirstate),
-        mb_bitvec=state.mb_bitvec.at[tgt_r, tgt_p].set(
-            cand.bitvec.reshape(F, -1), mode="drop"),
-        mb_head=new_head,
-        mb_count=new_count.at[tgt_r].add(accept.astype(jnp.int32), mode="drop"),
-        fault_key=state.fault_key,
-    )
-    return updates, dropped, jnp.zeros((), jnp.int32)
-
-mailbox.deliver = deliver_nosort
-nosort = timeit(step.run_cycles, state)
-print(f"no-sort delivery:    {nosort/K*1e6:9.1f} us/cycle   (sort cost ~{(full-nosort)/K*1e6:.1f} us)")
-
-# 3. no delivery at all (messages vanish) — measures all of phase 3
+# 2. no delivery at all (messages vanish) — measures all of phase 3
 def deliver_null(cfg, state, cand, arb_rank, new_head, new_count):
     z = jnp.zeros((), jnp.int32)
     return dict(mb_head=new_head, mb_count=new_count,
@@ -90,4 +44,4 @@ def deliver_null(cfg, state, cand, arb_rank, new_head, new_count):
 mailbox.deliver = deliver_null
 nodeliv = timeit(step.run_cycles, state)
 print(f"null delivery:       {nodeliv/K*1e6:9.1f} us/cycle   (delivery total ~{(full-nodeliv)/K*1e6:.1f} us)")
-mailbox.deliver = orig_deliver
+mailbox.deliver = orig_deliver  # noqa: F841

@@ -11,7 +11,7 @@ K = 256
 
 
 def timeit(cfg, st):
-    se.run_rounds.clear_cache()
+    se._run_rounds_jit.clear_cache()
     out = se.run_rounds(cfg, st, K)
     int(out.metrics.rounds)
     t0 = time.perf_counter()

@@ -11,11 +11,15 @@ emitted messages.
 """
 
 import jax.numpy as jnp
+import numpy as np
 
 from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
 from ue22cs343bb1_openmp_assignment_tpu.ops.mailbox import push_message
 from ue22cs343bb1_openmp_assignment_tpu.ops.step import cycle
-from ue22cs343bb1_openmp_assignment_tpu.state import init_state
+from ue22cs343bb1_openmp_assignment_tpu.state import (MB_ADDR, MB_BV0,
+                                                      MB_DIRSTATE, MB_SECOND,
+                                                      MB_SENDER, MB_TYPE,
+                                                      MB_VALUE, init_state)
 from ue22cs343bb1_openmp_assignment_tpu.types import (CacheState, DirState,
                                                       Msg, Op)
 
@@ -32,13 +36,14 @@ def inbox(state, node):
     h, c = int(state.mb_head[node]), int(state.mb_count[node])
     for i in range(c):
         s = (h + i) % CFG.queue_capacity
-        out.append(dict(type=Msg(int(state.mb_type[node, s])),
-                        sender=int(state.mb_sender[node, s]),
-                        addr=int(state.mb_addr[node, s]),
-                        value=int(state.mb_value[node, s]),
-                        second=int(state.mb_second[node, s]),
-                        dirstate=int(state.mb_dirstate[node, s]),
-                        bitvec=int(state.mb_bitvec[node, s, 0])))
+        row = state.mb_pack[node, s]
+        out.append(dict(type=Msg(int(row[MB_TYPE])),
+                        sender=int(row[MB_SENDER]),
+                        addr=int(row[MB_ADDR]),
+                        value=int(row[MB_VALUE]),
+                        second=int(row[MB_SECOND]),
+                        dirstate=int(row[MB_DIRSTATE]),
+                        bitvec=int(np.uint32(row[MB_BV0]))))
     return out
 
 

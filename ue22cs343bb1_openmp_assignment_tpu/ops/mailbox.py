@@ -27,7 +27,13 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
+import jax
+
 from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.state import (MB_ADDR, MB_BV0,
+                                                      MB_DIRSTATE, MB_SECOND,
+                                                      MB_SENDER, MB_TYPE,
+                                                      MB_VALUE)
 from ue22cs343bb1_openmp_assignment_tpu.types import Msg
 
 
@@ -75,22 +81,23 @@ def dequeue(cfg: SystemConfig, state) -> tuple:
     Returns (MsgView, new_head, new_count). Mirrors the drain step at
     ``assignment.c:174-177`` (one message per node per cycle; the
     drain-all-first priority emerges because instruction fetch is gated on
-    an empty queue, see ops.step).
+    an empty queue, see ops.step). One row gather serves every field.
     """
     N = cfg.num_nodes
     rows = jnp.arange(N)
     has = state.mb_count > 0
     h = state.mb_head
     safe_h = jnp.where(has, h, 0)
+    row = state.mb_pack[rows, safe_h]                  # [N, 6 + Wm]
     view = MsgView(
         has_msg=has,
-        type=jnp.where(has, state.mb_type[rows, safe_h], int(Msg.NONE)),
-        sender=state.mb_sender[rows, safe_h],
-        addr=state.mb_addr[rows, safe_h],
-        value=state.mb_value[rows, safe_h],
-        second=state.mb_second[rows, safe_h],
-        dirstate=state.mb_dirstate[rows, safe_h],
-        bitvec=state.mb_bitvec[rows, safe_h],
+        type=jnp.where(has, row[:, MB_TYPE], int(Msg.NONE)),
+        sender=row[:, MB_SENDER],
+        addr=row[:, MB_ADDR],
+        value=row[:, MB_VALUE],
+        second=row[:, MB_SECOND],
+        dirstate=row[:, MB_DIRSTATE],
+        bitvec=jax.lax.bitcast_convert_type(row[:, MB_BV0:], jnp.uint32),
     )
     new_head = jnp.where(has, (h + 1) % cfg.queue_capacity, h)
     new_count = state.mb_count - has.astype(jnp.int32)
@@ -161,7 +168,6 @@ def deliver(cfg: SystemConfig, state, cand: Candidates, arb_rank,
     fault_key = state.fault_key
     injected = jnp.zeros((), jnp.int32)
     if cfg.drop_prob > 0.0:
-        import jax
         key = jax.random.wrap_key_data(state.fault_key)
         k_draw, k_next = jax.random.split(key)
         hit = jax.random.bernoulli(k_draw, cfg.drop_prob, accept.shape)
@@ -178,19 +184,17 @@ def deliver(cfg: SystemConfig, state, cand: Candidates, arb_rank,
     tgt_r = jnp.where(accept, r_s, N)      # OOB row -> dropped by scatter
     tgt_p = jnp.where(accept, pos, 0)
 
-    def put(arr, field):
-        vals = field.reshape(F)[order] if field.ndim == 2 else field.reshape(F, -1)[order]
-        return arr.at[tgt_r, tgt_p].set(vals, mode="drop")
+    # pack the candidate fields into message rows; the whole delivery is
+    # then ONE scatter of [F, 6 + Wm] rows
+    pack = jnp.concatenate(
+        [jnp.stack([cand.type, cand.sender, cand.addr,
+                    cand.value, cand.second, cand.dirstate],
+                   axis=-1).reshape(F, 6),
+         jax.lax.bitcast_convert_type(cand.bitvec, jnp.int32).reshape(F, -1)],
+        axis=1)[order]
 
     updates = dict(
-        mb_type=put(state.mb_type, cand.type),
-        mb_sender=put(state.mb_sender, cand.sender),
-        mb_addr=put(state.mb_addr, cand.addr),
-        mb_value=put(state.mb_value, cand.value),
-        mb_second=put(state.mb_second, cand.second),
-        mb_dirstate=put(state.mb_dirstate, cand.dirstate),
-        mb_bitvec=state.mb_bitvec.at[tgt_r, tgt_p].set(
-            cand.bitvec.reshape(F, -1)[order], mode="drop"),
+        mb_pack=state.mb_pack.at[tgt_r, tgt_p].set(pack, mode="drop"),
         mb_head=new_head,
         mb_count=new_count.at[tgt_r].add(
             accept.astype(jnp.int32), mode="drop"),
@@ -200,7 +204,6 @@ def deliver(cfg: SystemConfig, state, cand: Candidates, arb_rank,
 
 
 def jax_cummax(x: jnp.ndarray) -> jnp.ndarray:
-    import jax
     return jax.lax.associative_scan(jnp.maximum, x)
 
 
@@ -222,13 +225,11 @@ def push_message(cfg: SystemConfig, state, receiver: int, *, type,
     bv_int = int(bitvec)
     for w in range(W):
         bv = bv.at[w].set((bv_int >> (32 * w)) & 0xFFFFFFFF)
+    row = jnp.concatenate(
+        [jnp.asarray([int(type), int(sender), int(addr), int(value),
+                      int(second), int(dirstate)], jnp.int32),
+         jax.lax.bitcast_convert_type(bv, jnp.int32)])
     return state.replace(
-        mb_type=state.mb_type.at[r, tail].set(int(type)),
-        mb_sender=state.mb_sender.at[r, tail].set(int(sender)),
-        mb_addr=state.mb_addr.at[r, tail].set(int(addr)),
-        mb_value=state.mb_value.at[r, tail].set(int(value)),
-        mb_second=state.mb_second.at[r, tail].set(int(second)),
-        mb_dirstate=state.mb_dirstate.at[r, tail].set(int(dirstate)),
-        mb_bitvec=state.mb_bitvec.at[r, tail].set(bv),
+        mb_pack=state.mb_pack.at[r, tail].set(row),
         mb_count=state.mb_count.at[r].add(1),
     )

@@ -64,7 +64,8 @@ from flax import struct
 
 from ue22cs343bb1_openmp_assignment_tpu import codec
 from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
-from ue22cs343bb1_openmp_assignment_tpu.state import SimState
+from ue22cs343bb1_openmp_assignment_tpu.state import (SimState,
+                                                      build_instr_arrays)
 from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState, Op
 
 # dm column layout: the per-(home, block) directory/memory table, one row
@@ -221,7 +222,6 @@ def continue_with_traces(cfg: SystemConfig, st: SyncState, traces=None,
     if not bool(st.quiescent()):
         raise ValueError(
             "continue_with_traces needs a fully retired machine")
-    from ue22cs343bb1_openmp_assignment_tpu.state import build_instr_arrays
     op, addr, val, count = build_instr_arrays(
         cfg, traces=traces, instr_arrays=instr_arrays)
     # phase boundary: reset the round counter and the round-tagged
@@ -245,6 +245,20 @@ def to_dump_view(cfg: SystemConfig, st: SyncState):
         memory=memory, dir_state=dir_state, dir_bitvec=bv,
         cache_addr=st.cache_addr, cache_val=st.cache_val,
         cache_state=st.cache_state)
+
+
+def _assert_round_budget(cfg: SystemConfig, start_round, n: int) -> None:
+    """The budget is on the ABSOLUTE round counter (claim keys count
+    down from claim_max_rounds): entry round + requested rounds must
+    stay inside it. `round` resets at phase boundaries
+    (continue_with_traces), not on checkpoint resume. Host-side (reads
+    the round scalar), so the public runners call it outside jit."""
+    start = int(start_round)
+    budget = claim_max_rounds(cfg)
+    assert start + n < budget, (
+        f"round {start} + {n} rounds exceeds the claim-key budget "
+        f"{budget} at {cfg.num_nodes} nodes; chain phases via "
+        "continue_with_traces to reset the round counter")
 
 
 def claim_max_rounds(cfg: SystemConfig) -> int:
@@ -565,14 +579,17 @@ def ensemble_replica(st: SyncState, r: int) -> SyncState:
     return jax.tree.map(lambda x: x[r], st)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 2, 3))
 def run_ensemble_to_quiescence(cfg: SystemConfig, st: SyncState,
                                chunk: int = 32,
                                max_rounds: int = 100_000) -> SyncState:
     """Run an [R, ...] ensemble until every replica's traces retire."""
-    assert max_rounds < claim_max_rounds(cfg), (
-        f"max_rounds {max_rounds} exceeds the claim-key budget "
-        f"{claim_max_rounds(cfg)} at {cfg.num_nodes} nodes")
+    _assert_round_budget(cfg, st.round[0], max_rounds)
+    return _run_ensemble_jit(cfg, st, chunk, max_rounds)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2, 3))
+def _run_ensemble_jit(cfg: SystemConfig, st: SyncState, chunk: int,
+                      max_rounds: int) -> SyncState:
     vround = jax.vmap(lambda s: round_step(cfg, s))
 
     def body(s, _):
@@ -593,27 +610,30 @@ def run_ensemble_to_quiescence(cfg: SystemConfig, st: SyncState,
 
 # -- runners ---------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnums=(0, 2))
 def run_rounds(cfg: SystemConfig, st: SyncState, n: int) -> SyncState:
-    assert n < claim_max_rounds(cfg), (
-        f"{n} rounds exceeds the claim-key budget "
-        f"{claim_max_rounds(cfg)} at {cfg.num_nodes} nodes")
+    _assert_round_budget(cfg, st.round, n)
+    return _run_rounds_jit(cfg, st, n)
 
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def _run_rounds_jit(cfg: SystemConfig, st: SyncState, n: int) -> SyncState:
     def body(s, _):
         return round_step(cfg, s), None
     st, _ = jax.lax.scan(body, st, None, length=n)
     return st
 
 
-@functools.partial(jax.jit, static_argnums=(0, 2, 3))
 def run_sync_to_quiescence(cfg: SystemConfig, st: SyncState,
                            chunk: int = 32,
                            max_rounds: int = 100_000) -> SyncState:
     """Run until every trace is fully retired (chunked single dispatch)."""
-    assert max_rounds < claim_max_rounds(cfg), (
-        f"max_rounds {max_rounds} exceeds the claim-key budget "
-        f"{claim_max_rounds(cfg)} at {cfg.num_nodes} nodes")
+    _assert_round_budget(cfg, st.round, max_rounds)
+    return _run_sync_jit(cfg, st, chunk, max_rounds)
 
+
+@functools.partial(jax.jit, static_argnums=(0, 2, 3))
+def _run_sync_jit(cfg: SystemConfig, st: SyncState, chunk: int,
+                  max_rounds: int) -> SyncState:
     def body(s, _):
         return round_step(cfg, s), None
 

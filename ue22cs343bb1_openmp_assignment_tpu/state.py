@@ -52,6 +52,11 @@ class Metrics(struct.PyTreeNode):
                    invalidations=z, evictions=z)
 
 
+# mb_pack column layout
+MB_TYPE, MB_SENDER, MB_ADDR, MB_VALUE, MB_SECOND, MB_DIRSTATE, MB_BV0 = (
+    0, 1, 2, 3, 4, 5, 6)
+
+
 class SimState(struct.PyTreeNode):
     """Full machine state. Shapes: N nodes, C cache lines, M memory blocks,
     T max trace length, Q mailbox capacity, W bitvector words."""
@@ -87,15 +92,11 @@ class SimState(struct.PyTreeNode):
     waiting_since: jnp.ndarray # [N] i32
 
     # -- mailboxes (reference messageBuffer, assignment.c:81-87) ----------
-    mb_type: jnp.ndarray       # [N, Q] i32, Msg (NONE = empty slot)
-    mb_sender: jnp.ndarray     # [N, Q] i32
-    mb_addr: jnp.ndarray       # [N, Q] i32
-    mb_value: jnp.ndarray      # [N, Q] i32
-    mb_second: jnp.ndarray     # [N, Q] i32
-    mb_dirstate: jnp.ndarray   # [N, Q] i32
-    mb_bitvec: jnp.ndarray     # [N, Q, Wm] u32 (REPLY_ID sharer payload;
-                               #   Wm = cfg.msg_bitvec_words — one dummy
-                               #   word in scatter INV mode)
+    # one packed ring tensor: columns MB_TYPE..MB_DIRSTATE then
+    # cfg.msg_bitvec_words bitvector words (u32 bitcast to i32) — a
+    # message is one row, so dequeue is ONE gather and delivery ONE
+    # scatter regardless of field count
+    mb_pack: jnp.ndarray       # [N, Q, 6 + Wm] i32
     mb_head: jnp.ndarray       # [N] i32
     mb_count: jnp.ndarray      # [N] i32
 
@@ -187,13 +188,8 @@ def init_state(cfg: SystemConfig, traces=None, issue_delay=None,
         cur_val=jnp.zeros((N,), jnp.int32),
         waiting=jnp.zeros((N,), bool),
         waiting_since=jnp.full((N,), -1, jnp.int32),
-        mb_type=jnp.full((N, Q), int(Msg.NONE), jnp.int32),
-        mb_sender=jnp.zeros((N, Q), jnp.int32),
-        mb_addr=jnp.zeros((N, Q), jnp.int32),
-        mb_value=jnp.zeros((N, Q), jnp.int32),
-        mb_second=jnp.zeros((N, Q), jnp.int32),
-        mb_dirstate=jnp.zeros((N, Q), jnp.int32),
-        mb_bitvec=jnp.zeros((N, Q, Wm), jnp.uint32),
+        mb_pack=jnp.zeros((N, Q, 6 + Wm), jnp.int32).at[:, :, MB_TYPE].set(
+            int(Msg.NONE)),
         mb_head=jnp.zeros((N,), jnp.int32),
         mb_count=jnp.zeros((N,), jnp.int32),
         issue_delay=jnp.asarray(issue_delay, jnp.int32),
