@@ -1,8 +1,6 @@
 """Per-round cost ablation for the sync engine (throwaway)."""
 import time
 
-import jax
-
 from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
 from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
 from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se

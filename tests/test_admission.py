@@ -9,7 +9,6 @@ never overflow; these tests pin both the failure mode and the fix.
 """
 
 import numpy as np
-import pytest
 
 from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
 from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem

@@ -6,7 +6,6 @@ on demand, and clean errors for engine/feature mismatches.
 """
 
 import json
-import os
 
 import pytest
 

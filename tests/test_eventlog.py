@@ -5,7 +5,6 @@ as device-side event arrays + byte-compatible host rendering)."""
 import os
 
 from tests.conftest import REFERENCE_TESTS, requires_reference
-from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
 from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
 from ue22cs343bb1_openmp_assignment_tpu.utils import eventlog
 
@@ -94,3 +93,32 @@ def test_msg_log_format():
            "addr": 0x05, "value": 200}
     assert (eventlog.format_record(rec)
             == "Processor 0: instr type=W, address=0x05, value=200")
+
+
+@requires_reference
+def test_sync_engine_trace_log_program_order(tmp_path):
+    """The sync engine's retirement log (run_rounds_traced +
+    eventlog.sync_to_records) projects to per-node program order,
+    matching the reference's instruction_order.txt projection for the
+    deterministic suite."""
+    from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+    from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+    from ue22cs343bb1_openmp_assignment_tpu.state import init_state
+    from ue22cs343bb1_openmp_assignment_tpu.utils.trace import load_test_dir
+
+    ref_dir = os.path.join(REFERENCE_TESTS, "test_1")
+    cfg = SystemConfig.reference()
+    traces = load_test_dir(ref_dir)
+    st = se.from_sim_state(cfg, init_state(cfg, traces))
+    st, events = se.run_rounds_traced(cfg, st, 64)
+    assert bool(st.quiescent())
+    lines = [eventlog.format_record(r)
+             for r in eventlog.sync_to_records(events)]
+    golden = open(f"{ref_dir}/instruction_order.txt").read().splitlines()
+    ours = eventlog.per_node_projection(lines)
+    theirs = eventlog.per_node_projection(golden)
+    assert ours == theirs
+
+    path = str(tmp_path / "order.txt")
+    eventlog.write_sync_log(path, events)
+    assert open(path).read().splitlines() == lines

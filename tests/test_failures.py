@@ -3,12 +3,10 @@ fault is a silent overflow drop, and a stranded node spins forever with
 no detection, assignment.c:754-762,624-629)."""
 
 import numpy as np
-import pytest
 
 from tests.conftest import REFERENCE_TESTS, requires_reference
 from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
 from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
-from ue22cs343bb1_openmp_assignment_tpu.ops import failures
 
 
 def _cross_node_system(drop_prob, fault_seed=0, nodes=16):

@@ -26,7 +26,6 @@ from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
 from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
 from ue22cs343bb1_openmp_assignment_tpu.ops.step import run_to_quiescence
 from ue22cs343bb1_openmp_assignment_tpu.state import init_state
-from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState
 from ue22cs343bb1_openmp_assignment_tpu.utils.golden import (format_node_dump,
                                                              state_to_dumps)
 from ue22cs343bb1_openmp_assignment_tpu.utils.trace import load_test_dir

@@ -5,7 +5,6 @@ import jax
 import numpy as np
 import pytest
 
-from ue22cs343bb1_openmp_assignment_tpu import codec
 from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
 from ue22cs343bb1_openmp_assignment_tpu.models import workloads
 from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem

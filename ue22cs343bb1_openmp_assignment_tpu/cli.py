@@ -148,7 +148,7 @@ def _main_sync(args) -> int:
     for flag, why in (("delays", "message-level issue schedules"),
                       ("periods", "message-level issue schedules"),
                       ("drop_prob", "message-drop fault injection"),
-                      ("trace_log", "message/instruction event tracing"),
+                      ("trace_msgs", "message-dequeue event tracing"),
                       ("admission", "mailbox backpressure")):
         if getattr(args, flag):
             print(f"error: --{flag.replace('_', '-')} needs the mailbox "
@@ -196,10 +196,29 @@ def _main_sync(args) -> int:
             return 2
         st = se.from_sim_state(cfg, system.state, seed=seed)
 
-    if args.run_cycles is not None:
-        from ue22cs343bb1_openmp_assignment_tpu.ops.sync_engine import (
-            run_rounds)
-        st = run_rounds(cfg, st, args.run_cycles)
+    if args.trace_log:
+        from ue22cs343bb1_openmp_assignment_tpu.utils import eventlog
+        chunk = 32
+        cap = (args.run_cycles if args.run_cycles is not None
+               else args.max_cycles)
+        base = int(st.round)
+        all_events = []
+        done = 0
+        while done < cap:
+            n = min(chunk, cap - done)
+            st, ev = se.run_rounds_traced(cfg, st, n)
+            all_events.append({k: np.asarray(v) for k, v in ev.items()})
+            done += n
+            if args.run_cycles is None and bool(st.quiescent()):
+                break
+        merged = {k: np.concatenate([e[k] for e in all_events])
+                  for k in all_events[0]} if all_events else {}
+        if merged:
+            eventlog.write_sync_log(args.trace_log, merged, base)
+        else:
+            open(args.trace_log, "w").close()
+    elif args.run_cycles is not None:
+        st = se.run_rounds(cfg, st, args.run_cycles)
     else:
         st = se.run_sync_to_quiescence(cfg, st, 16, args.max_cycles)
     if args.save_checkpoint:

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 
 from ue22cs343bb1_openmp_assignment_tpu import codec
 from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
-from ue22cs343bb1_openmp_assignment_tpu.ops.mailbox import Candidates, MsgView
+from ue22cs343bb1_openmp_assignment_tpu.ops.mailbox import MsgView
 from ue22cs343bb1_openmp_assignment_tpu.state import (SimState, bit_single,
                                                       ctz, popcount)
 from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState, Msg

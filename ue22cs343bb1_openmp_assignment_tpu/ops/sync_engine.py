@@ -339,8 +339,16 @@ def _mix(x: jnp.ndarray) -> jnp.ndarray:
     return x
 
 
-def round_step(cfg: SystemConfig, st: SyncState) -> SyncState:
-    """Advance every node by one burst of hits plus one transaction."""
+def round_step(cfg: SystemConfig, st: SyncState,
+               with_events: bool = False):
+    """Advance every node by one burst of hits plus one transaction.
+
+    ``with_events=True`` additionally returns this round's retirement
+    record — per-node, per-window-slot (op, addr, value, retired) — the
+    transactional engine's answer to the reference's ``-DDEBUG_INSTR``
+    tracing (``assignment.c:649-652``); utils.eventlog renders it in the
+    exact ``instruction_order.txt`` line format. Default path pays
+    nothing."""
     N, C, M = cfg.num_nodes, cfg.cache_size, cfg.mem_size
     T = st.instr_pack.shape[1]
     H = cfg.drain_depth
@@ -556,8 +564,18 @@ def round_step(cfg: SystemConfig, st: SyncState) -> SyncState:
         invalidations=mt.invalidations + jnp.sum(kill, dtype=jnp.int32),
         promotions=mt.promotions + jnp.sum(promo, dtype=jnp.int32),
     )
-    return st.replace(cache_addr=ca, cache_val=cv, cache_state=cs, dm=dm,
-                      idx=new_idx, round=st.round + 1, metrics=metrics)
+    new_st = st.replace(cache_addr=ca, cache_val=cv, cache_state=cs,
+                        dm=dm, idx=new_idx, round=st.round + 1,
+                        metrics=metrics)
+    if not with_events:
+        return new_st
+    # retirement record: burst slots below d, plus the transaction slot
+    # when it won (slot order == program order within the round)
+    slot_retired = (offs < d[:, None]) | ((offs == d[:, None])
+                                          & win[:, None])
+    events = {"retired": slot_retired, "op": w_op, "addr": w_addr,
+              "value": w_val}
+    return new_st, events
 
 
 # -- ensembles -------------------------------------------------------------
@@ -609,6 +627,21 @@ def _run_ensemble_jit(cfg: SystemConfig, st: SyncState, chunk: int,
 
 
 # -- runners ---------------------------------------------------------------
+
+def run_rounds_traced(cfg: SystemConfig, st: SyncState, n: int):
+    """Scan n rounds collecting the retirement record: events are
+    [n, N, drain_depth+1] arrays (utils.eventlog.sync_to_records)."""
+    _assert_round_budget(cfg, st.round, n)
+    return _run_rounds_traced_jit(cfg, st, n)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def _run_rounds_traced_jit(cfg: SystemConfig, st: SyncState, n: int):
+    def body(s, _):
+        return round_step(cfg, s, with_events=True)
+
+    return jax.lax.scan(body, st, None, length=n)
+
 
 def run_rounds(cfg: SystemConfig, st: SyncState, n: int) -> SyncState:
     _assert_round_budget(cfg, st.round, n)

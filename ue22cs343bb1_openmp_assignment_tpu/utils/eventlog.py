@@ -60,6 +60,22 @@ def to_records(events: Dict, base_cycle: int = 0) -> List[dict]:
     return sorted(msgs + instrs, key=lambda r: (r["cycle"], r["node"]))
 
 
+def sync_to_records(events: Dict, base_round: int = 0) -> List[dict]:
+    """Flatten the sync engine's [T, N, K] retirement record
+    (ops.sync_engine.run_rounds_traced) into (round, node, slot)-ordered
+    instr records. Slot order within a round is program order; the
+    canonical cross-node order is (round, node) — one legal
+    serialization, like the async engine's (cycle, node)."""
+    ev = _np_events(events)
+    rt, rn, rk = np.nonzero(ev["retired"])
+    return [{"kind": "instr", "cycle": base_round + int(t), "node": int(n),
+             "op": int(o), "addr": int(a), "value": int(v)}
+            for t, n, o, a, v in zip(
+                rt, rn, ev["op"][rt, rn, rk], ev["addr"][rt, rn, rk],
+                ev["value"][rt, rn, rk])
+            if int(o) != int(Op.NOP)]  # NOP padding retires silently
+
+
 def format_record(rec: dict) -> str:
     """One record → the reference's printf line (byte-compatible)."""
     if rec["kind"] == "instr":
@@ -83,6 +99,14 @@ def write_log(path: str, events: Dict, kinds=("instr",),
     with open(path, "w") as f:
         for line in to_lines(events, kinds, base_cycle):
             f.write(line + "\n")
+
+
+def write_sync_log(path: str, events: Dict, base_round: int = 0) -> None:
+    """Render a sync-engine retirement record to instruction_order.txt
+    format."""
+    with open(path, "w") as f:
+        for rec in sync_to_records(events, base_round):
+            f.write(format_record(rec) + "\n")
 
 
 def per_node_projection(lines: List[str]) -> Dict[int, List[str]]:
