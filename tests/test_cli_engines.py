@@ -81,3 +81,25 @@ def test_native_nodes_beyond_fixture_errors(tmp_path, monkeypatch,
          "--engine", "native", "--nodes", "8"],
         tmp_path, monkeypatch, capsys)
     assert rc == 1 and "core_4" in err
+
+
+@requires_reference
+def test_sweep_seeds_matches_accepted_runs(tmp_path, monkeypatch, capsys):
+    """--sweep-seeds: the batched run-until-match harness (test3.sh
+    replacement) reports seeds reproducing accepted outcomes."""
+    rc, out, _ = run_cli(
+        ["test_3", "--tests-root", REFERENCE_TESTS, "--cpu",
+         "--engine", "sync", "--sweep-seeds", "8"],
+        tmp_path, monkeypatch, capsys)
+    assert rc == 0
+    report = json.loads(out.strip().splitlines()[-1])
+    assert report["accepted_runs"] == 2
+    assert report["matches"]  # some seed reproduces an accepted run
+    assert set(report["matches"].values()) <= {"run_1", "run_2"}
+
+
+def test_sweep_seeds_needs_sync_engine(tmp_path, monkeypatch, capsys):
+    rc, _, err = run_cli(
+        ["test_3", "--tests-root", REFERENCE_TESTS, "--cpu",
+         "--sweep-seeds", "4"], tmp_path, monkeypatch, capsys)
+    assert rc == 2 and "--engine sync" in err

@@ -72,3 +72,31 @@ def test_generators_are_seed_deterministic():
         for x, y in zip(a, b):
             np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
                                           err_msg=name)
+
+
+def test_hotspot_temporal_locality():
+    """Hotspot traces must be hit-dominated: consecutive accesses revisit
+    a small working set, unlike the capacity-miss-bound uniform load."""
+    import jax
+    from ue22cs343bb1_openmp_assignment_tpu.models.system import (
+        CoherenceSystem)
+    from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+
+    cfg = SystemConfig.scale(num_nodes=32, max_instrs=64)
+    op, addr, val, count = workloads.hotspot(
+        jax.random.PRNGKey(0), cfg, 64)
+    assert op.shape == (32, 64) and int(count[0]) == 64
+    # addresses valid
+    import numpy as np
+    a = np.asarray(addr)
+    assert a.min() >= 0 and a.max() < (32 << cfg.block_bits)
+
+    sys_ = CoherenceSystem.from_workload(cfg, "hotspot", trace_len=64,
+                                         seed=0)
+    st = se.run_sync_to_quiescence(
+        cfg, se.from_sim_state(cfg, sys_.state), 16, 50_000)
+    assert bool(st.quiescent())
+    m = st.metrics
+    hits = int(m.read_hits) + int(m.write_hits)
+    misses = int(m.read_misses) + int(m.write_misses) + int(m.upgrades)
+    assert hits > misses, (hits, misses)  # temporal locality pays off

@@ -128,10 +128,53 @@ def radix_sort(key, cfg: SystemConfig, trace_len: int, radix: int = 16):
     return op, addr, val, jnp.full((N,), trace_len, jnp.int32)
 
 
+def hotspot(key, cfg: SystemConfig, trace_len: int,
+            working_set: int = 2, migrate_prob: float = 0.05,
+            write_frac: float = 0.5):
+    """Temporal-locality workload: each node hammers a small working set
+    of blocks (its own plus one shared remote region), occasionally
+    migrating to a new set.
+
+    The uniform workload has no temporal locality, so 16 blocks vs 4
+    lines per node makes capacity misses dominate; real cache studies
+    need hit-dominated phases too. Here consecutive accesses revisit
+    `working_set` blocks until a migration draw (`migrate_prob`)
+    switches the set — producing long runs that the sync engine's hit
+    burst retires in bulk and the async engine serves without traffic.
+    """
+    N = cfg.num_nodes
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+    shape = (N, trace_len)
+    # segment index = number of migrations so far (prefix sum of draws)
+    migrate = jax.random.uniform(k1, shape) < migrate_prob
+    seg = jnp.cumsum(migrate.astype(jnp.int32), axis=1)
+    # per-(node, segment) private anchor, and per-SEGMENT shared anchor
+    # (node-independent so concurrent hot segments really do collide on
+    # the same blocks of the hot node — the sharing/invalidation phase)
+    seg_key = jnp.arange(N, dtype=jnp.int32)[:, None] * 131071 + seg
+    h = (seg_key.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)) >> 8
+    h_shared = ((seg.astype(jnp.uint32) + jnp.uint32(0x51ED2705))
+                * jnp.uint32(0x85EBCA77)) >> 8
+    own = jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32)[:, None], shape)
+    hot = jax.random.randint(k2, (), 0, N, dtype=jnp.int32)
+    is_hot = (h & 3) == 0
+    node = jnp.where(is_hot, hot, own)
+    base = jnp.where(is_hot, h_shared.astype(jnp.int32),
+                     h.astype(jnp.int32) >> 2) % cfg.mem_size
+    off = jax.random.randint(k3, shape, 0, working_set, dtype=jnp.int32)
+    block = (base + off) % cfg.mem_size
+    addr = codec.make_address(cfg, node, block)
+    is_write = jax.random.uniform(k4, shape) < write_frac
+    op = jnp.where(is_write, int(Op.WRITE), int(Op.READ)).astype(jnp.int32)
+    val = jax.random.randint(k5, shape, 0, 256, dtype=jnp.int32)
+    return op, addr, val, jnp.full((N,), trace_len, jnp.int32)
+
+
 GENERATORS = {
     "uniform": uniform_random,
     "producer_consumer": producer_consumer,
     "false_sharing": false_sharing,
     "fft": fft_transpose,
     "radix": radix_sort,
+    "hotspot": hotspot,
 }

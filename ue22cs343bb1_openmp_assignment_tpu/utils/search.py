@@ -64,11 +64,18 @@ def match_accepted(cfg: SystemConfig, sim_state,
     return out
 
 
-def load_accepted(suite_dir: str, num_cores: int = 4) -> List[List[str]]:
-    """Load the accepted run_* dump sets of a reference racy suite."""
+def load_accepted_named(suite_dir: str, num_cores: int = 4):
+    """[(run_dir_name, per-core dumps)] for a racy suite's run_* dirs."""
     import glob
+    import os
     out = []
     for rd in sorted(glob.glob(f"{suite_dir}/run_*")):
-        out.append([open(f"{rd}/core_{n}_output.txt").read()
-                    for n in range(num_cores)])
+        out.append((os.path.basename(rd),
+                    [open(f"{rd}/core_{n}_output.txt").read()
+                     for n in range(num_cores)]))
     return out
+
+
+def load_accepted(suite_dir: str, num_cores: int = 4) -> List[List[str]]:
+    """Load the accepted run_* dump sets of a reference racy suite."""
+    return [dumps for _, dumps in load_accepted_named(suite_dir, num_cores)]
