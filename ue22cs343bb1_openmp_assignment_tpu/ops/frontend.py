@@ -112,12 +112,14 @@ def instruction_phase(cfg: SystemConfig, state: SimState, may_issue):
     req_value = jnp.where(is_write, val, 0)
     request_part = (req_type, i_home, addr, req_value)
 
+    # per-node masks; ops.step folds them into ONE stacked reduction
+    # (separate jnp.sum calls each cost a kernel dispatch, PERF.md)
     stats = dict(
-        read_hits=jnp.sum(read_hit).astype(jnp.int32),
-        write_hits=jnp.sum(write_hit_me | write_hit_s).astype(jnp.int32),
-        read_misses=jnp.sum(read_miss).astype(jnp.int32),
-        write_misses=jnp.sum(write_miss).astype(jnp.int32),
-        upgrades=jnp.sum(write_hit_s).astype(jnp.int32),
-        issued=jnp.sum(fetch).astype(jnp.int32),
+        read_hits=read_hit,
+        write_hits=write_hit_me | write_hit_s,
+        read_misses=read_miss,
+        write_misses=write_miss,
+        upgrades=write_hit_s,
+        issued=fetch,
     )
     return updates, request_part, stats

@@ -268,8 +268,8 @@ def message_phase(cfg: SystemConfig, state: SimState, mv: MsgView):
 
     stats = dict(
         msg_type_onehot=(has, t),
-        invalidations=jnp.sum(inv_hits).astype(jnp.int32),
-        evictions=jnp.sum(evict_fire).astype(jnp.int32),
+        invalidations=inv_hits,     # [N] masks; reduced with the other
+        evictions=evict_fire,       # counters in one stacked sum (step)
         unblocked=wait_clear & state.waiting,
     )
     return updates, cand_parts, inv_scatter, stats

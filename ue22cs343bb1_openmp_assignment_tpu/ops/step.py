@@ -185,23 +185,34 @@ def cycle(cfg: SystemConfig, state: SimState,
         cache_state = jnp.where(kill, int(CacheState.INVALID), cache_state)
 
     # ---- metrics ---------------------------------------------------------
+    # ONE stacked reduction for every per-node counter delta, including
+    # the per-message-type histogram (a one-hot instead of a scatter-add)
+    # — separate sums/scatters each cost a kernel dispatch (PERF.md)
     mt = state.metrics
     has, t = m_stats["msg_type_onehot"]
-    msgs = mt.msgs_processed.at[jnp.where(has, t, 13)].add(1, mode="drop")
+    type_onehot = (jnp.arange(13, dtype=jnp.int32)[:, None] == t[None, :]) \
+        & has[None, :]                                          # [13, N]
+    counters = jnp.stack([
+        f_stats["issued"], f_stats["read_hits"], f_stats["write_hits"],
+        f_stats["read_misses"], f_stats["write_misses"],
+        f_stats["upgrades"], m_stats["invalidations"],
+        m_stats["evictions"],
+    ])                                                          # [8, N]
+    deltas = jnp.sum(jnp.concatenate([counters, type_onehot]).astype(
+        jnp.int32), axis=1)                                     # [21]
     metrics = mt.replace(
         cycles=mt.cycles + 1,
-        instrs_retired=mt.instrs_retired + f_stats["issued"],
-        read_hits=mt.read_hits + f_stats["read_hits"],
-        write_hits=mt.write_hits + f_stats["write_hits"],
-        read_misses=mt.read_misses + f_stats["read_misses"],
-        write_misses=mt.write_misses + f_stats["write_misses"],
-        upgrades=mt.upgrades + f_stats["upgrades"],
-        msgs_processed=msgs,
+        instrs_retired=mt.instrs_retired + deltas[0],
+        read_hits=mt.read_hits + deltas[1],
+        write_hits=mt.write_hits + deltas[2],
+        read_misses=mt.read_misses + deltas[3],
+        write_misses=mt.write_misses + deltas[4],
+        upgrades=mt.upgrades + deltas[5],
+        msgs_processed=mt.msgs_processed + deltas[8:21],
         msgs_dropped=mt.msgs_dropped + dropped,
         msgs_injected_dropped=mt.msgs_injected_dropped + injected,
-        invalidations=mt.invalidations + m_stats["invalidations"]
-        + inv_applied,
-        evictions=mt.evictions + m_stats["evictions"],
+        invalidations=mt.invalidations + deltas[6] + inv_applied,
+        evictions=mt.evictions + deltas[7],
     )
 
     new_state = state.replace(

@@ -397,8 +397,11 @@ def round_step(cfg: SystemConfig, st: SyncState,
     prefix = jnp.cumprod(w_hit[:, :H].astype(jnp.int32), axis=1)  # [N, H]
     d = jnp.sum(prefix, axis=1)                                   # [N] <= H
     in_burst = prefix.astype(bool)                                # [N, H]
-    rh = jnp.sum(w_rdhit[:, :H] & in_burst, dtype=jnp.int32)
-    wh = jnp.sum(w_wrhit[:, :H] & in_burst, dtype=jnp.int32)
+    # burst hit counts per node (summed with the other metrics below in
+    # one stacked reduction — separate jnp.sum calls each cost a kernel
+    # dispatch on the bench device, PERF.md)
+    rh_n = jnp.sum(w_rdhit[:, :H] & in_burst, axis=1, dtype=jnp.int32)
+    wh_n = jnp.sum(w_wrhit[:, :H] & in_burst, axis=1, dtype=jnp.int32)
     # burst write effects per line: last write in the burst wins; any
     # write leaves the line MODIFIED (static H-step fold, all fused)
     for k in range(H):
@@ -549,20 +552,30 @@ def round_step(cfg: SystemConfig, st: SyncState,
 
     # ---- bookkeeping -----------------------------------------------------
     new_idx = idx0 + d + win.astype(jnp.int32)
+    # ONE stacked reduction for every counter delta (each separate
+    # jnp.sum is its own kernel dispatch on the bench device)
+    deltas = jnp.sum(jnp.stack([
+        d + win.astype(jnp.int32),                     # instrs retired
+        rh_n, wh_n,
+        rd_w.astype(jnp.int32), wr_w.astype(jnp.int32),
+        up_w.astype(jnp.int32), (txn & ~win).astype(jnp.int32),
+        ev.astype(jnp.int32),
+        jnp.sum(kill, axis=1, dtype=jnp.int32),
+        jnp.sum(promo, axis=1, dtype=jnp.int32),
+    ]), axis=1)                                        # [10]
     mt = st.metrics
     metrics = mt.replace(
         rounds=mt.rounds + 1,
-        instrs_retired=mt.instrs_retired
-        + jnp.sum(d, dtype=jnp.int32) + jnp.sum(win, dtype=jnp.int32),
-        read_hits=mt.read_hits + rh,
-        write_hits=mt.write_hits + wh,
-        read_misses=mt.read_misses + jnp.sum(rd_w, dtype=jnp.int32),
-        write_misses=mt.write_misses + jnp.sum(wr_w, dtype=jnp.int32),
-        upgrades=mt.upgrades + jnp.sum(up_w, dtype=jnp.int32),
-        conflicts=mt.conflicts + jnp.sum(txn & ~win, dtype=jnp.int32),
-        evictions=mt.evictions + jnp.sum(ev, dtype=jnp.int32),
-        invalidations=mt.invalidations + jnp.sum(kill, dtype=jnp.int32),
-        promotions=mt.promotions + jnp.sum(promo, dtype=jnp.int32),
+        instrs_retired=mt.instrs_retired + deltas[0],
+        read_hits=mt.read_hits + deltas[1],
+        write_hits=mt.write_hits + deltas[2],
+        read_misses=mt.read_misses + deltas[3],
+        write_misses=mt.write_misses + deltas[4],
+        upgrades=mt.upgrades + deltas[5],
+        conflicts=mt.conflicts + deltas[6],
+        evictions=mt.evictions + deltas[7],
+        invalidations=mt.invalidations + deltas[8],
+        promotions=mt.promotions + deltas[9],
     )
     new_st = st.replace(cache_addr=ca, cache_val=cv, cache_state=cs,
                         dm=dm, idx=new_idx, round=st.round + 1,
