@@ -43,6 +43,10 @@ def main():
                          "requests (None = reference drop semantics)")
     ap.add_argument("--reps", type=int, default=3,
                     help="timed repetitions; the median is reported")
+    ap.add_argument("--profile", metavar="DIR",
+                    help="capture a jax.profiler trace of one timed run "
+                         "into DIR (viewable with TensorBoard/Perfetto; "
+                         "SURVEY §5 tracing)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny config on CPU for smoke testing")
     args = ap.parse_args()
@@ -126,6 +130,16 @@ def main():
         return int(np.sum(np.asarray(st.metrics.instrs_retired)))
 
     total_retired(run())              # warmup; device_get = real sync
+
+    if args.profile:
+        try:
+            with jax.profiler.trace(args.profile):
+                total_retired(run())
+            print(f"profiler trace written to {args.profile}",
+                  file=sys.stderr)
+        except Exception as e:  # some device plugins can't profile
+            print(f"warning: profiler capture failed: {e}",
+                  file=sys.stderr)
 
     # median of --reps timed runs: the device link is shared, with
     # ~1.5x run-to-run noise; the median is the defensible headline

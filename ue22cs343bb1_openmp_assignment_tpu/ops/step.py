@@ -190,8 +190,9 @@ def cycle(cfg: SystemConfig, state: SimState,
     # — separate sums/scatters each cost a kernel dispatch (PERF.md)
     mt = state.metrics
     has, t = m_stats["msg_type_onehot"]
-    type_onehot = (jnp.arange(13, dtype=jnp.int32)[:, None] == t[None, :]) \
-        & has[None, :]                                          # [13, N]
+    K = mt.msgs_processed.shape[0]                # message-type count
+    type_onehot = (jnp.arange(K, dtype=jnp.int32)[:, None] == t[None, :]) \
+        & has[None, :]                                          # [K, N]
     counters = jnp.stack([
         f_stats["issued"], f_stats["read_hits"], f_stats["write_hits"],
         f_stats["read_misses"], f_stats["write_misses"],
@@ -199,7 +200,7 @@ def cycle(cfg: SystemConfig, state: SimState,
         m_stats["evictions"],
     ])                                                          # [8, N]
     deltas = jnp.sum(jnp.concatenate([counters, type_onehot]).astype(
-        jnp.int32), axis=1)                                     # [21]
+        jnp.int32), axis=1)                                     # [8 + K]
     metrics = mt.replace(
         cycles=mt.cycles + 1,
         instrs_retired=mt.instrs_retired + deltas[0],
@@ -208,7 +209,7 @@ def cycle(cfg: SystemConfig, state: SimState,
         read_misses=mt.read_misses + deltas[3],
         write_misses=mt.write_misses + deltas[4],
         upgrades=mt.upgrades + deltas[5],
-        msgs_processed=mt.msgs_processed + deltas[8:21],
+        msgs_processed=mt.msgs_processed + deltas[8:8 + K],
         msgs_dropped=mt.msgs_dropped + dropped,
         msgs_injected_dropped=mt.msgs_injected_dropped + injected,
         invalidations=mt.invalidations + deltas[6] + inv_applied,
