@@ -1,0 +1,102 @@
+"""Outcome-set inclusion: sync ⊆ async on contended micro-traces.
+
+The sync engine's claim (ops/sync_engine.py docstring) is that every
+atomic-transaction serialization it realizes is a *reachable* schedule
+of the message-level machine. For tiny, maximally contended traces the
+outcome space is small enough to sample exhaustively: sweep the sync
+engine's arbitration seeds, sweep the async engine's schedule knobs
+(issue delays + arbitration permutations), fingerprint final states,
+and require every sync outcome to appear in the async outcome set.
+
+A failure here would mean the transactional engine produces a final
+state the reference machine cannot — a real semantic divergence, not a
+schedule difference.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+from ue22cs343bb1_openmp_assignment_tpu.ops.step import run_to_quiescence
+from ue22cs343bb1_openmp_assignment_tpu.state import init_state
+
+
+def fingerprint_async(st):
+    return (np.asarray(st.cache_addr).tobytes()
+            + np.asarray(st.cache_val).tobytes()
+            + np.asarray(st.cache_state).tobytes()
+            + np.asarray(st.memory).tobytes()
+            + np.asarray(st.dir_state).tobytes()
+            + np.asarray(st.dir_bitvec).tobytes())
+
+
+def fingerprint_sync(cfg, st):
+    mem, ds, bv = se.to_sim_arrays(cfg, st)
+    return (np.asarray(st.cache_addr).tobytes()
+            + np.asarray(st.cache_val).tobytes()
+            + np.asarray(st.cache_state).tobytes()
+            + mem.tobytes() + ds.astype(np.int32).tobytes()
+            + bv.tobytes())
+
+
+def async_outcomes(cfg, traces, max_delay=6):
+    """Final-state set over issue-delay tuples x arbitration ranks."""
+    out = {}
+    active = [n for n, tr in enumerate(traces) if tr]
+    ranks = list(itertools.permutations(range(cfg.num_nodes)))[:8]
+    for delays in itertools.product(range(0, max_delay, 2),
+                                    repeat=len(active)):
+        d = np.zeros(cfg.num_nodes, np.int32)
+        for n, dv in zip(active, delays):
+            d[n] = dv
+        for rank in ranks[:4]:
+            st = init_state(cfg, traces, issue_delay=d,
+                            arb_rank=np.asarray(rank, np.int32))
+            st = run_to_quiescence(cfg, st, 10_000)
+            assert bool(st.quiescent())
+            out[fingerprint_async(st)] = (tuple(delays), rank)
+    return out
+
+
+def sync_outcomes(cfg, traces, seeds=range(12)):
+    out = {}
+    for seed in seeds:
+        st = se.from_sim_state(cfg, init_state(cfg, traces), seed=seed)
+        st = se.run_sync_to_quiescence(cfg, st, 4, 10_000)
+        assert bool(st.quiescent())
+        se.check_exact_directory(cfg, st)
+        out[fingerprint_sync(cfg, st)] = seed
+    return out
+
+
+CASES = {
+    # write-write race on one remote block
+    "ww_race": [[(1, 0x20, 11)], [(1, 0x20, 22)], [], []],
+    # read-write race: reader may see before or after
+    "rw_race": [[(0, 0x20, 0)], [(1, 0x20, 33)], [], []],
+    # upgrade race: both read (SHARED) then both write
+    "upgrade_race": [[(0, 0x20, 0), (1, 0x20, 44)],
+                     [(0, 0x20, 0), (1, 0x20, 55)], [], []],
+    # eviction pressure: conflict-miss displacement during sharing
+    "evict_race": [[(1, 0x21, 66), (0, 0x31, 0)],
+                   [(0, 0x21, 0), (1, 0x21, 77)], [], []],
+    # three-way ownership migration
+    "migrate3": [[(1, 0x30, 1)], [(1, 0x30, 2)], [(1, 0x30, 3)], []],
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_sync_outcomes_are_reachable_async_outcomes(name):
+    cfg = SystemConfig.reference()
+    traces = CASES[name]
+    a = async_outcomes(cfg, traces)
+    s = sync_outcomes(cfg, traces)
+    assert len(a) >= 1 and len(s) >= 1
+    missing = {fp: seed for fp, seed in s.items() if fp not in a}
+    assert not missing, (
+        f"{name}: sync seeds {sorted(missing.values())} produced final "
+        f"states outside the async outcome set "
+        f"({len(s)} sync / {len(a)} async outcomes)")

@@ -1,0 +1,65 @@
+"""TransactionalSystem high-level API (models.transactional)."""
+
+import numpy as np
+import pytest
+
+from tests.conftest import REFERENCE_TESTS, requires_reference
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
+from ue22cs343bb1_openmp_assignment_tpu.models.transactional import (
+    TransactionalSystem)
+
+
+@requires_reference
+def test_fixture_run_and_dumps(tmp_path):
+    sys_ = TransactionalSystem.from_test_dir(
+        f"{REFERENCE_TESTS}/test_1").run()
+    assert sys_.quiescent
+    assert sys_.metrics["instrs_retired"] == 68
+    sys_.check_invariants()
+    dumps = sys_.dumps()
+    for n in range(4):
+        golden = open(
+            f"{REFERENCE_TESTS}/test_1/core_{n}_output.txt").read()
+        assert dumps[n] == golden
+    paths = sys_.write_dumps(str(tmp_path))
+    assert len(paths) == 4
+
+
+def test_workload_run_save_load_continue(tmp_path):
+    cfg = SystemConfig.scale(num_nodes=32, max_instrs=16)
+    sys_ = TransactionalSystem.from_workload(
+        cfg, "uniform", trace_len=16, workload_seed=1, seed=3,
+        local_frac=0.4).run()
+    assert sys_.quiescent and sys_.instrs_retired == 32 * 16
+    path = str(tmp_path / "t.ckpt")
+    sys_.save(path)
+    restored = TransactionalSystem.load(path)
+    assert restored.quiescent
+    nxt = CoherenceSystem.from_workload(cfg, "uniform", trace_len=16,
+                                        seed=2).state
+    cont = restored.continue_with(
+        instr_arrays=(nxt.instr_op, nxt.instr_addr, nxt.instr_val,
+                      nxt.instr_count)).run()
+    assert cont.quiescent and cont.instrs_retired == 2 * 32 * 16
+    cont.check_invariants()
+
+
+def test_step_and_ensemble():
+    cfg = SystemConfig.scale(num_nodes=16, max_instrs=8)
+    sys_ = TransactionalSystem.from_workload(cfg, "uniform", trace_len=8)
+    one = sys_.step()
+    assert int(one.state.round) == 1
+    ens = sys_.ensemble([0, 1, 2])
+    assert ens.cache_addr.shape[0] == 3
+    assert [int(s) for s in ens.seed] == [0, 1, 2]
+
+
+def test_load_rejects_async_checkpoint(tmp_path):
+    cfg = SystemConfig.scale(num_nodes=8, max_instrs=8)
+    base = CoherenceSystem.from_workload(cfg, "uniform", trace_len=8)
+    path = str(tmp_path / "a.ckpt")
+    base.save(path)
+    with pytest.raises(ValueError, match="CoherenceSystem"):
+        TransactionalSystem.load(path)

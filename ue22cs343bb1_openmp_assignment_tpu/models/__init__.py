@@ -1,0 +1,11 @@
+"""Model-level APIs: the two high-level machine wrappers.
+
+CoherenceSystem  — message-level engine (byte-parity / research path)
+TransactionalSystem — atomic-round engine (throughput / ensemble path)
+"""
+
+from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
+from ue22cs343bb1_openmp_assignment_tpu.models.transactional import (
+    TransactionalSystem)
+
+__all__ = ["CoherenceSystem", "TransactionalSystem"]

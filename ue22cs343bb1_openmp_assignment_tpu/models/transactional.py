@@ -1,0 +1,132 @@
+"""TransactionalSystem — the sync engine's high-level API.
+
+The throughput twin of models.system.CoherenceSystem: same constructor
+surface (fixture tree, synthetic workloads, raw traces), same verbs
+(step/run/dumps/save/load/check/metrics), running the transactional
+engine (ops.sync_engine) instead of the message-level one. Adds the
+capabilities specific to that engine: trace streaming
+(`continue_with`), batched seed ensembles (`ensemble`), and the
+exact-directory invariant check at any round boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
+from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+from ue22cs343bb1_openmp_assignment_tpu.utils import checkpoint, golden
+
+
+@dataclasses.dataclass
+class TransactionalSystem:
+    """A configured transactional coherence machine with its state."""
+
+    cfg: SystemConfig
+    state: se.SyncState
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def from_test_dir(cls, test_dir: str,
+                      cfg: Optional[SystemConfig] = None,
+                      seed: int = 0) -> "TransactionalSystem":
+        base = CoherenceSystem.from_test_dir(test_dir, cfg)
+        return cls(base.cfg, se.from_sim_state(base.cfg, base.state, seed))
+
+    @classmethod
+    def from_workload(cls, cfg: SystemConfig, name: str = "uniform",
+                      trace_len: Optional[int] = None,
+                      workload_seed: int = 0, seed: int = 0,
+                      **gen_kw) -> "TransactionalSystem":
+        base = CoherenceSystem.from_workload(
+            cfg, name, trace_len=trace_len, seed=workload_seed, **gen_kw)
+        return cls(base.cfg, se.from_sim_state(base.cfg, base.state, seed))
+
+    @classmethod
+    def from_traces(cls, cfg: SystemConfig, traces: Sequence,
+                    seed: int = 0) -> "TransactionalSystem":
+        base = CoherenceSystem.from_traces(cfg, traces)
+        return cls(cfg, se.from_sim_state(cfg, base.state, seed))
+
+    # -- execution ---------------------------------------------------------
+    def step(self) -> "TransactionalSystem":
+        """Advance one round (unjitted; for debugging/inspection)."""
+        return dataclasses.replace(
+            self, state=se.round_step(self.cfg, self.state))
+
+    def run(self, max_rounds: int = 100_000,
+            chunk: int = 32) -> "TransactionalSystem":
+        """Run until every trace retires (chunked single dispatch)."""
+        final = se.run_sync_to_quiescence(self.cfg, self.state, chunk,
+                                          max_rounds)
+        return dataclasses.replace(self, state=final)
+
+    def run_rounds(self, n: int) -> "TransactionalSystem":
+        return dataclasses.replace(
+            self, state=se.run_rounds(self.cfg, self.state, n))
+
+    def continue_with(self, traces=None,
+                      instr_arrays=None) -> "TransactionalSystem":
+        """Stream the next trace phase into the retired machine."""
+        return dataclasses.replace(
+            self, state=se.continue_with_traces(
+                self.cfg, self.state, traces=traces,
+                instr_arrays=instr_arrays))
+
+    # -- ensembles ---------------------------------------------------------
+    def ensemble(self, seeds: Sequence[int]) -> se.SyncState:
+        """[len(seeds), ...] ensemble of this machine under each seed."""
+        return se.make_ensemble(
+            [self.state.replace(seed=_i32(s)) for s in seeds])
+
+    # -- inspection --------------------------------------------------------
+    @property
+    def quiescent(self) -> bool:
+        return bool(self.state.quiescent())
+
+    @property
+    def metrics(self) -> dict:
+        import jax
+        m = self.state.metrics
+        out = {f: jax.device_get(getattr(m, f))
+               for f in m.__dataclass_fields__}
+        return {k: (v.tolist() if hasattr(v, "tolist") else v)
+                for k, v in out.items()}
+
+    @property
+    def instrs_retired(self) -> int:
+        return int(self.state.metrics.instrs_retired)
+
+    def check_invariants(self) -> dict:
+        """Exact-directory invariant (valid at any round boundary)."""
+        return se.check_exact_directory(self.cfg, self.state)
+
+    def dumps(self) -> List[str]:
+        """printProcessorState-format dumps (byte-parity surface)."""
+        view = se.to_dump_view(self.cfg, self.state)
+        return [golden.format_node_dump(d)
+                for d in golden.state_to_dumps(self.cfg, view)]
+
+    def write_dumps(self, out_dir: str) -> List[str]:
+        return golden.write_dumps(
+            self.cfg, se.to_dump_view(self.cfg, self.state), out_dir)
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path: str, meta: Optional[dict] = None) -> None:
+        checkpoint.save_checkpoint(path, self.cfg, self.state, meta)
+
+    @classmethod
+    def load(cls, path: str) -> "TransactionalSystem":
+        cfg, state, meta = checkpoint.load_checkpoint(path)
+        if meta.get("kind") != "sync":
+            raise ValueError(
+                f"{path} holds an async-engine (SimState) checkpoint; "
+                "load it with models.system.CoherenceSystem")
+        return cls(cfg, state)
+
+
+def _i32(x):
+    import jax.numpy as jnp
+    return jnp.asarray(x, jnp.int32)

@@ -226,8 +226,9 @@ def continue_with_traces(cfg: SystemConfig, st: SyncState, traces=None,
         cfg, traces=traces, instr_arrays=instr_arrays)
     # phase boundary: reset the round counter and the round-tagged
     # claim/action columns, so the claim-key budget and action-tag
-    # namespace are per phase (metrics stay cumulative)
-    dm = st.dm.at[:, DM_CLAIM].set(jnp.iinfo(jnp.int32).max)
+    # namespace are per phase (metrics stay cumulative). asarray: a
+    # checkpoint-restored state carries host numpy arrays.
+    dm = jnp.asarray(st.dm).at[:, DM_CLAIM].set(jnp.iinfo(jnp.int32).max)
     dm = dm.at[:, DM_ACT].set(-4)
     return st.replace(
         dm=dm,
