@@ -1,0 +1,18 @@
+"""examples/quickstart.py must keep running end-to-end (docs that rot
+are worse than no docs)."""
+
+import pathlib
+import runpy
+
+REPO_ROOT = pathlib.Path(__file__).parents[1]
+
+
+def test_quickstart_runs(capsys, monkeypatch):
+    monkeypatch.chdir(REPO_ROOT)
+    runpy.run_path(str(REPO_ROOT / "examples" / "quickstart.py"),
+                   run_name="__main__")
+    out = capsys.readouterr().out
+    assert "async engine quiescent: True" in out
+    assert "sync engine:" in out
+    assert "streamed 2nd phase: 131072" in out
+    assert "sharded one round" in out
