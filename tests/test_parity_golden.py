@@ -67,3 +67,29 @@ def test_deterministic_suites_schedule_independent():
             issue_delay=rng.randint(0, 5, size=4).astype(np.int32),
             issue_period=rng.randint(1, 4, size=4).astype(np.int32))
         assert dumps == base, f"schedule trial {trial} changed test_1 output"
+
+
+@requires_reference
+def test_schedule_knobs_reach_distinct_accepted_runs():
+    """The schedule knobs genuinely explore the racy outcome space: on
+    test_4, different issue delays reproduce *different* accepted runs
+    (the property the reference could only get from OS scheduling luck,
+    README.md:10)."""
+    import numpy as np
+    accepted = []
+    for run_dir in sorted(glob.glob(f"{REFERENCE_TESTS}/test_4/run_*")):
+        accepted.append([open(f"{run_dir}/core_{n}_output.txt").read()
+                        for n in range(4)])
+
+    def outcome(delays):
+        dumps = run_suite("test_4",
+                          issue_delay=np.asarray(delays, np.int32))
+        for i, acc in enumerate(accepted):
+            if dumps == acc:
+                return i
+        return None
+
+    a = outcome([0, 0, 0, 0])
+    b = outcome([4, 0, 0, 0])
+    assert a is not None and b is not None, (a, b)
+    assert a != b, "both delay schedules landed on the same accepted run"
