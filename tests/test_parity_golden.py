@@ -7,7 +7,6 @@ schedule knobs (issue delays / arbitration) take the place of the
 reference's run-until-match retry harness (test3.sh:6-33).
 """
 
-import glob
 import os
 
 import pytest
@@ -44,11 +43,9 @@ def test_deterministic_suites_byte_exact(suite):
 @requires_reference
 @pytest.mark.parametrize("suite", ["test_3", "test_4"])
 def test_racy_suites_match_an_accepted_run(suite):
+    from ue22cs343bb1_openmp_assignment_tpu.utils.search import load_accepted
     dumps = run_suite(suite)
-    accepted = []
-    for run_dir in sorted(glob.glob(f"{REFERENCE_TESTS}/{suite}/run_*")):
-        accepted.append([open(f"{run_dir}/core_{n}_output.txt").read()
-                        for n in range(4)])
+    accepted = load_accepted(os.path.join(REFERENCE_TESTS, suite))
     assert any(dumps == g for g in accepted), (
         f"{suite}: default schedule matched no accepted run")
 
@@ -70,26 +67,31 @@ def test_deterministic_suites_schedule_independent():
 
 
 @requires_reference
-def test_schedule_knobs_reach_distinct_accepted_runs():
-    """The schedule knobs genuinely explore the racy outcome space: on
-    test_4, different issue delays reproduce *different* accepted runs
-    (the property the reference could only get from OS scheduling luck,
-    README.md:10)."""
+@pytest.mark.parametrize("suite,delays_a,delays_b", [
+    # test_3: delaying core 2 past core 0's final write flips 0x01 from
+    # EM/{0}+MODIFIED (run_1) to S/{0,2}+SHARED (run_2)
+    ("test_3", [0, 0, 0, 0], [0, 0, 20, 0]),
+    ("test_4", [0, 0, 0, 0], [4, 0, 0, 0]),
+])
+def test_schedule_knobs_reach_distinct_accepted_runs(suite, delays_a,
+                                                     delays_b):
+    """The schedule knobs genuinely explore the racy outcome space:
+    different issue delays reproduce *different* accepted runs — the
+    property the reference could only get from OS scheduling luck
+    (README.md:10)."""
     import numpy as np
-    accepted = []
-    for run_dir in sorted(glob.glob(f"{REFERENCE_TESTS}/test_4/run_*")):
-        accepted.append([open(f"{run_dir}/core_{n}_output.txt").read()
-                        for n in range(4)])
+
+    from ue22cs343bb1_openmp_assignment_tpu.utils.search import load_accepted
+    accepted = load_accepted(os.path.join(REFERENCE_TESTS, suite))
 
     def outcome(delays):
-        dumps = run_suite("test_4",
-                          issue_delay=np.asarray(delays, np.int32))
+        dumps = run_suite(suite, issue_delay=np.asarray(delays, np.int32))
         for i, acc in enumerate(accepted):
             if dumps == acc:
                 return i
         return None
 
-    a = outcome([0, 0, 0, 0])
-    b = outcome([4, 0, 0, 0])
+    a = outcome(delays_a)
+    b = outcome(delays_b)
     assert a is not None and b is not None, (a, b)
     assert a != b, "both delay schedules landed on the same accepted run"
