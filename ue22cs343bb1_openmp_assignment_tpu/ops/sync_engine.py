@@ -36,11 +36,11 @@ sharer *bitvector* redundant:
   At 4096 nodes this shrinks the directory 32x and removes every
   bitvector gather from the hot path.
 
-Per-round device work (the whole machine, any N):
-  2 window gathers (instruction burst) + 1 claim scatter-min +
-  3 directory-row gathers + 1 owner-value gather + 2 effect scatters +
-  1 per-line action gather + fused elementwise.
-No sort, no mailbox tensor. Conflicts (two transactions claiming one
+Per-round device work (the whole machine, any N): 4 gathers (packed
+instruction window; both claimed directory rows; the EM owner's cache
+value; the per-line action lookup) + 3 scatters (claim min; packed
+entry effects; promotion owner) + fused elementwise, one stacked metric
+reduction. No sort, no mailbox tensor. Conflicts (two transactions claiming one
 directory entry, or a transaction claiming another's victim entry) are
 resolved by a per-round seeded hash priority: losers simply retry next
 round — the analogue of losing the lock-acquisition race in the
