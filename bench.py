@@ -26,7 +26,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--engine", choices=["sync", "async"], default="sync")
     ap.add_argument("--nodes", type=int, default=4096)
-    ap.add_argument("--trace-len", type=int, default=96)
+    ap.add_argument("--trace-len", type=int, default=4096,
+                    help="instructions per node; the default is long "
+                         "enough to measure sustained throughput (the "
+                         "device link adds ~0.1 s fixed dispatch cost "
+                         "per run, PERF.md)")
     ap.add_argument("--chunk", type=int, default=32,
                     help="cycles/rounds per quiescence-check chunk "
                          "(32 measured best on the attached device)")
@@ -43,6 +47,14 @@ def main():
                          "requests (None = reference drop semantics)")
     ap.add_argument("--reps", type=int, default=3,
                     help="timed repetitions; the median is reported")
+    ap.add_argument("--procedural", default=True,
+                    action=argparse.BooleanOptionalAction,
+                    help="sync engine: compute the uniform workload "
+                         "procedurally inside the round (O(1) trace "
+                         "memory, no window gather; --trace-len may be "
+                         "arbitrarily long). Bit-exact-equivalent to the "
+                         "materialized stream (tests/test_procedural.py); "
+                         "--no-procedural gathers a stored trace instead")
     ap.add_argument("--profile", metavar="DIR",
                     help="capture a jax.profiler trace of one timed run "
                          "into DIR (viewable with TensorBoard/Perfetto; "
@@ -70,6 +82,18 @@ def main():
     cfg = SystemConfig.scale(num_nodes=args.nodes,
                              admission_window=args.admission,
                              drain_depth=args.drain_depth)
+    if args.procedural and (args.engine != "sync"
+                            or args.workload != "uniform"
+                            or args.replicas > 1):
+        print("note: --procedural needs the sync engine, the uniform "
+              "workload and --replicas 1; measuring stored traces "
+              "instead", file=sys.stderr)
+        args.procedural = False
+    if args.procedural:
+        import dataclasses
+        cfg = dataclasses.replace(
+            cfg, procedural="uniform", max_instrs=1,
+            proc_local_permille=int(args.local_frac * 1000))
     gen_kw = {"local_frac": args.local_frac} if args.workload == "uniform" else {}
 
     def make_system(seed):
@@ -105,6 +129,15 @@ def main():
 
         def steps(st):
             return int(st.metrics.rounds[0])
+    elif args.engine == "sync" and args.procedural:
+        st0 = se.procedural_state(cfg, args.trace_len, seed=0)
+
+        def run():
+            return se.run_sync_to_quiescence(cfg, st0, args.chunk,
+                                             max_cycles)
+
+        def steps(st):
+            return int(st.metrics.rounds)
     elif args.engine == "sync":
         st0 = se.from_sim_state(cfg, make_system(0).state, seed=0)
 
@@ -153,6 +186,7 @@ def main():
     elapsed = times[len(times) // 2]
     value = retired / elapsed
     rep = (f", {args.replicas} replicas" if args.replicas > 1 else "")
+    rep += ", procedural" if args.procedural else ""
     result = {
         "metric": f"simulated RD/WR instrs/sec @{args.nodes} cores "
                   f"({args.engine} engine, {args.workload}{rep}, 1 chip, "

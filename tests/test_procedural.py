@@ -1,0 +1,72 @@
+"""Procedural workloads: O(1)-trace-memory instruction streams.
+
+The sync engine can compute instructions per (node, index) from a
+counter-based hash inside the round (cfg.procedural) instead of
+gathering from a stored [N, T] trace. The materializer
+(workloads.procedural_uniform) produces the identical stream as arrays,
+so procedural and materialized runs must agree bit-for-bit — and trace
+length can far exceed any storable array.
+"""
+
+import dataclasses
+
+import numpy as np
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
+from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+
+
+def test_procedural_equals_materialized():
+    cfg = SystemConfig.scale(num_nodes=64, max_instrs=48,
+                             procedural="uniform", proc_seed=7)
+    proc = se.procedural_state(cfg, 48, seed=3)
+    proc = se.run_sync_to_quiescence(cfg, proc, 16, 50_000)
+    assert bool(proc.quiescent())
+    se.check_exact_directory(cfg, proc)
+
+    cfg_mat = dataclasses.replace(cfg, procedural=None)
+    mat_sys = CoherenceSystem.from_workload(cfg_mat, "procedural_uniform",
+                                            trace_len=48)
+    mat = se.from_sim_state(cfg_mat, mat_sys.state, seed=3)
+    mat = se.run_sync_to_quiescence(cfg_mat, mat, 16, 50_000)
+    assert bool(mat.quiescent())
+
+    np.testing.assert_array_equal(np.asarray(proc.cache_val),
+                                  np.asarray(mat.cache_val))
+    np.testing.assert_array_equal(np.asarray(proc.cache_addr),
+                                  np.asarray(mat.cache_addr))
+    np.testing.assert_array_equal(np.asarray(proc.dm[:, :4]),
+                                  np.asarray(mat.dm[:, :4]))
+    assert (int(proc.metrics.instrs_retired)
+            == int(mat.metrics.instrs_retired) == 64 * 48)
+
+
+def test_procedural_beyond_storable_length():
+    """Trace length way past max_instrs: no [N, T] array ever exists."""
+    cfg = SystemConfig.scale(num_nodes=32, max_instrs=8,
+                             procedural="uniform", drain_depth=8)
+    length = 5000                      # >> max_instrs; storage stays [N,1]
+    st = se.procedural_state(cfg, length)
+    assert st.instr_pack.shape == (32, 1, 2)
+    st = se.run_sync_to_quiescence(cfg, st, 32, 100_000)
+    assert bool(st.quiescent())
+    assert int(st.metrics.instrs_retired) == 32 * length
+    se.check_exact_directory(cfg, st)
+
+
+def test_procedural_addresses_valid():
+    cfg = SystemConfig.scale(num_nodes=16, procedural="uniform")
+    import jax.numpy as jnp
+    nodes = jnp.arange(16, dtype=jnp.int32)[:, None]
+    idxs = jnp.arange(200, dtype=jnp.int32)[None, :]
+    oa, val = se.procedural_instr(cfg, nodes, idxs)
+    addr = np.asarray(oa & 0x0FFFFFFF)
+    op = np.asarray(oa >> 28)
+    assert addr.min() >= 0 and addr.max() < (16 << cfg.block_bits)
+    assert set(np.unique(op)) <= {0, 1}
+    assert np.asarray(val).min() >= 0 and np.asarray(val).max() < 256
+    # locality roughly matches proc_local_permille
+    home = addr >> cfg.block_bits
+    local_frac = float((home == np.arange(16)[:, None]).mean())
+    assert 0.7 < local_frac < 0.9

@@ -56,6 +56,18 @@ class SystemConfig:
     # depth realizes a legal schedule.
     drain_depth: int = 4
 
+    # Procedural workload (sync engine): when set (e.g. "uniform"),
+    # instructions are computed per (node, index) from a counter-based
+    # hash inside the round instead of gathered from a stored [N, T]
+    # trace — O(1) trace memory for arbitrarily long runs, and one
+    # fewer gather per round. Parameters are permille ints so the
+    # config stays hashable/static. models.workloads.procedural_uniform
+    # materializes the identical trace for cross-checking.
+    procedural: str | None = None
+    proc_local_permille: int = 800
+    proc_write_permille: int = 500
+    proc_seed: int = 0
+
     # Admission window (backpressure): maximum number of simultaneously
     # outstanding request transactions system-wide. The reference silently
     # drops on overflow (assignment.c:754-762), which at its dimensions is

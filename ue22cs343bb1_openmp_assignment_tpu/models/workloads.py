@@ -170,6 +170,23 @@ def hotspot(key, cfg: SystemConfig, trace_len: int,
     return op, addr, val, jnp.full((N,), trace_len, jnp.int32)
 
 
+def procedural_uniform(key, cfg: SystemConfig, trace_len: int):
+    """Materialized twin of the sync engine's procedural 'uniform'
+    source (ops.sync_engine.procedural_instr): identical instructions,
+    stored as arrays — for parity checks against procedural runs and
+    for feeding the other engines. The PRNG `key` is unused; the
+    stream is determined by cfg.proc_seed (counter-based)."""
+    from ue22cs343bb1_openmp_assignment_tpu.procedural import (
+        procedural_instr)
+    del key
+    N = cfg.num_nodes
+    nodes = jnp.arange(N, dtype=jnp.int32)[:, None]
+    idxs = jnp.arange(trace_len, dtype=jnp.int32)[None, :]
+    oa, val = procedural_instr(cfg, nodes, idxs)
+    return (oa >> 28, oa & 0x0FFFFFFF, val,
+            jnp.full((N,), trace_len, jnp.int32))
+
+
 GENERATORS = {
     "uniform": uniform_random,
     "producer_consumer": producer_consumer,
@@ -177,4 +194,5 @@ GENERATORS = {
     "fft": fft_transpose,
     "radix": radix_sort,
     "hotspot": hotspot,
+    "procedural_uniform": procedural_uniform,
 }

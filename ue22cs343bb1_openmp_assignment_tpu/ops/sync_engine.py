@@ -64,6 +64,7 @@ from flax import struct
 
 from ue22cs343bb1_openmp_assignment_tpu import codec
 from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.procedural import procedural_instr
 from ue22cs343bb1_openmp_assignment_tpu.state import (SimState,
                                                       build_instr_arrays)
 from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState, Op
@@ -329,6 +330,22 @@ def check_exact_directory(cfg: SystemConfig, st: SyncState) -> dict:
     }
 
 
+def procedural_state(cfg: SystemConfig, length: int,
+                     seed: int = 0) -> SyncState:
+    """A SyncState whose instructions come from cfg.procedural —
+    `length` instructions per node with O(1) trace storage (the
+    instr_pack placeholder has one slot; round_step never reads it in
+    procedural mode). `length` may far exceed cfg.max_instrs."""
+    if not cfg.procedural:
+        raise ValueError("cfg.procedural must name a generator")
+    N = cfg.num_nodes
+    from ue22cs343bb1_openmp_assignment_tpu.state import init_state
+    base = from_sim_state(cfg, init_state(cfg), seed=seed)
+    return base.replace(
+        instr_pack=jnp.zeros((N, 1, 2), jnp.int32),
+        instr_count=jnp.full((N,), int(length), jnp.int32))
+
+
 def _mix(x: jnp.ndarray) -> jnp.ndarray:
     """murmur3-style 32-bit finalizer (deterministic arbitration hash)."""
     x = jnp.asarray(x, jnp.uint32)
@@ -362,13 +379,17 @@ def round_step(cfg: SystemConfig, st: SyncState,
 
     # ---- instruction window: burst of up to H hits + the stopped instr ---
     # ONE flat gather for the whole window and both fields (idx advances
-    # by at most 1 per burst step, so H+1 lookahead always suffices)
+    # by at most 1 per burst step, so H+1 lookahead always suffices);
+    # procedural mode computes the window instead — no trace storage
     offs = jnp.arange(H + 1, dtype=jnp.int32)[None, :]          # [1, H+1]
     w_idx = idx0[:, None] + offs                                 # [N, H+1]
     w_live = w_idx < st.instr_count[:, None]
-    w_flat = rows[:, None] * T + jnp.minimum(w_idx, T - 1)
-    w = st.instr_pack.reshape(N * T, 2)[w_flat]                  # [N, H+1, 2]
-    w_oa, w_val = w[..., 0], w[..., 1]
+    if cfg.procedural:
+        w_oa, w_val = procedural_instr(cfg, rows[:, None], w_idx)
+    else:
+        w_flat = rows[:, None] * T + jnp.minimum(w_idx, T - 1)
+        w = st.instr_pack.reshape(N * T, 2)[w_flat]              # [N,H+1,2]
+        w_oa, w_val = w[..., 0], w[..., 1]
 
     # ---- phase 1: hit burst (node-local, no cross-node effects) ----------
     # Vectorized over the whole window at once: within a burst only hits
@@ -622,10 +643,12 @@ def run_ensemble_to_quiescence(cfg: SystemConfig, st: SyncState,
 @functools.partial(jax.jit, static_argnums=(0, 2, 3))
 def _run_ensemble_jit(cfg: SystemConfig, st: SyncState, chunk: int,
                       max_rounds: int) -> SyncState:
+    carry0, pack = _pack_outside(st)
     vround = jax.vmap(lambda s: round_step(cfg, s))
 
     def body(s, _):
-        return vround(s), None
+        out = vround(s.replace(instr_pack=pack))
+        return out.replace(instr_pack=carry0.instr_pack), None
 
     limit = st.round[0] + max_rounds
 
@@ -637,7 +660,8 @@ def _run_ensemble_jit(cfg: SystemConfig, st: SyncState, chunk: int,
         s, _ = jax.lax.scan(body, s, None, length=chunk)
         return s
 
-    return jax.lax.while_loop(cond, chunk_body, st)
+    final = jax.lax.while_loop(cond, chunk_body, carry0)
+    return final.replace(instr_pack=pack)
 
 
 # -- runners ---------------------------------------------------------------
@@ -651,10 +675,15 @@ def run_rounds_traced(cfg: SystemConfig, st: SyncState, n: int):
 
 @functools.partial(jax.jit, static_argnums=(0, 2))
 def _run_rounds_traced_jit(cfg: SystemConfig, st: SyncState, n: int):
-    def body(s, _):
-        return round_step(cfg, s, with_events=True)
+    carry0, pack = _pack_outside(st)
 
-    return jax.lax.scan(body, st, None, length=n)
+    def body(s, _):
+        out, ev = round_step(cfg, s.replace(instr_pack=pack),
+                             with_events=True)
+        return out.replace(instr_pack=carry0.instr_pack), ev
+
+    final, events = jax.lax.scan(body, carry0, None, length=n)
+    return final.replace(instr_pack=pack), events
 
 
 def run_rounds(cfg: SystemConfig, st: SyncState, n: int) -> SyncState:
@@ -664,10 +693,14 @@ def run_rounds(cfg: SystemConfig, st: SyncState, n: int) -> SyncState:
 
 @functools.partial(jax.jit, static_argnums=(0, 2))
 def _run_rounds_jit(cfg: SystemConfig, st: SyncState, n: int) -> SyncState:
+    carry0, pack = _pack_outside(st)
+
     def body(s, _):
-        return round_step(cfg, s), None
-    st, _ = jax.lax.scan(body, st, None, length=n)
-    return st
+        out = round_step(cfg, s.replace(instr_pack=pack))
+        return out.replace(instr_pack=carry0.instr_pack), None
+
+    final, _ = jax.lax.scan(body, carry0, None, length=n)
+    return final.replace(instr_pack=pack)
 
 
 def run_sync_to_quiescence(cfg: SystemConfig, st: SyncState,
@@ -678,11 +711,24 @@ def run_sync_to_quiescence(cfg: SystemConfig, st: SyncState,
     return _run_sync_jit(cfg, st, chunk, max_rounds)
 
 
+def _pack_outside(st: SyncState):
+    """(loop-carry state, hoisted trace): the instruction table is
+    read-only, and a large array in a scan/while carry gets copied every
+    iteration when XLA cannot prove aliasing — at [4096, 1024, 2] that
+    copy dominated the round (PERF.md). The loop carries a zero-width
+    placeholder instead; bodies close over the real table."""
+    placeholder = jnp.zeros(st.instr_pack.shape[:-2] + (0, 2), jnp.int32)
+    return st.replace(instr_pack=placeholder), st.instr_pack
+
+
 @functools.partial(jax.jit, static_argnums=(0, 2, 3))
 def _run_sync_jit(cfg: SystemConfig, st: SyncState, chunk: int,
                   max_rounds: int) -> SyncState:
+    carry0, pack = _pack_outside(st)
+
     def body(s, _):
-        return round_step(cfg, s), None
+        out = round_step(cfg, s.replace(instr_pack=pack))
+        return out.replace(instr_pack=carry0.instr_pack), None
 
     limit = st.round + max_rounds     # per-call budget (chained phases
                                       # reset `round`, see
@@ -695,4 +741,5 @@ def _run_sync_jit(cfg: SystemConfig, st: SyncState, chunk: int,
         s, _ = jax.lax.scan(body, s, None, length=chunk)
         return s
 
-    return jax.lax.while_loop(cond, chunk_body, st)
+    final = jax.lax.while_loop(cond, chunk_body, carry0)
+    return final.replace(instr_pack=pack)

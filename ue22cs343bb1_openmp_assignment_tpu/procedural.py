@@ -1,0 +1,51 @@
+"""Procedural instruction streams: hash-computed workloads.
+
+The 'uniform' stream as a pure function of (config, node, index) — the
+single source of truth used both by the sync engine inside the round
+(cfg.procedural: O(1) trace memory, no window gather) and by
+models.workloads.procedural_uniform, which materializes the identical
+stream as arrays for the other engines and for bit-exactness tests
+(tests/test_procedural.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ue22cs343bb1_openmp_assignment_tpu import codec
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.types import Op
+
+
+def _mix(x: jnp.ndarray) -> jnp.ndarray:
+    """murmur3-style 32-bit finalizer."""
+    x = jnp.asarray(x, jnp.uint32)
+    x ^= x >> 16
+    x *= jnp.uint32(0x7FEB352D)
+    x ^= x >> 15
+    x *= jnp.uint32(0x846CA68B)
+    x ^= x >> 16
+    return x
+
+
+def procedural_instr(cfg: SystemConfig, node, idx):
+    """(op << 28 | addr, value) for instruction `idx` of `node`.
+
+    node/idx: broadcastable i32 arrays. Parameters come from the config
+    (proc_seed / proc_local_permille / proc_write_permille)."""
+    N, M = cfg.num_nodes, cfg.mem_size
+    h = _mix((node.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
+             ^ (idx.astype(jnp.uint32) * jnp.uint32(0x85EBCA77))
+             ^ jnp.uint32(cfg.proc_seed * 2654435761 & 0xFFFFFFFF))
+    h2 = _mix(h ^ jnp.uint32(0xC2B2AE35))
+    is_write = (h % jnp.uint32(1000)).astype(jnp.int32) \
+        < cfg.proc_write_permille
+    local = ((h >> 10) % jnp.uint32(1000)).astype(jnp.int32) \
+        < cfg.proc_local_permille
+    remote = ((h2 % jnp.uint32(N))).astype(jnp.int32)
+    home = jnp.where(local, node, remote)
+    block = ((h2 >> 16) % jnp.uint32(M)).astype(jnp.int32)
+    addr = codec.make_address(cfg, home, block)
+    op = jnp.where(is_write, int(Op.WRITE), int(Op.READ))
+    val = ((h >> 21) & jnp.uint32(0xFF)).astype(jnp.int32)
+    return (op << 28) | addr, val
