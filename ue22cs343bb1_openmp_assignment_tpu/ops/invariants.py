@@ -186,17 +186,22 @@ def run_cycles_checked(cfg: SystemConfig, state: SimState,
     """
     import jax
 
-    from ue22cs343bb1_openmp_assignment_tpu.ops.step import cycle
+    from ue22cs343bb1_openmp_assignment_tpu.ops.step import (_RO_FIELDS,
+                                                             _ro_outside,
+                                                             cycle)
+
+    carry_state0, ro = _ro_outside(state)
 
     def body(carry, _):
         s, acc = carry
-        s = cycle(cfg, s)
+        s = cycle(cfg, s.replace(**ro))
         v = step_violations(cfg, s)
         acc = {k: acc[k] + v[k] for k in acc}
+        s = s.replace(**{f: getattr(carry_state0, f) for f in _RO_FIELDS})
         return (s, acc), None
 
     zero = {k: jnp.zeros((), jnp.int32)
             for k in step_violations(cfg, state)}
-    (state, acc), _ = jax.lax.scan(body, (state, zero), None,
+    (final, acc), _ = jax.lax.scan(body, (carry_state0, zero), None,
                                    length=num_cycles)
-    return state, acc
+    return final.replace(**ro), acc

@@ -237,6 +237,24 @@ def cycle(cfg: SystemConfig, state: SimState,
 
 # -- runners ---------------------------------------------------------------
 
+_RO_FIELDS = ("instr_op", "instr_addr", "instr_val", "issue_delay",
+              "issue_period", "arb_rank")
+
+
+def _ro_outside(state: SimState):
+    """(loop-carry state, dict of hoisted read-only fields): large
+    read-only arrays in a scan/while carry get copied every iteration
+    when XLA cannot prove aliasing (PERF.md) — the instruction trace and
+    schedule knobs never change during a run, so the loops carry
+    zero-width placeholders and bodies close over the real arrays."""
+    ro = {f: getattr(state, f) for f in _RO_FIELDS}
+    placeholders = {
+        f: jnp.zeros(v.shape[:-1] + (0,), v.dtype) if v.ndim > 1
+        else jnp.zeros((0,), v.dtype)
+        for f, v in ro.items()}
+    return state.replace(**placeholders), ro
+
+
 @functools.partial(jax.jit, static_argnums=(0, 2))
 def run_cycles_traced(cfg: SystemConfig, state: SimState,
                       num_cycles: int):
@@ -248,22 +266,29 @@ def run_cycles_traced(cfg: SystemConfig, state: SimState,
     ``instruction_order.txt`` line format).
     """
 
-    def body(s, _):
-        return cycle(cfg, s, with_events=True)
+    carry0, ro = _ro_outside(state)
 
-    return jax.lax.scan(body, state, None, length=num_cycles)
+    def body(s, _):
+        out, ev = cycle(cfg, s.replace(**ro), with_events=True)
+        return out.replace(**{f: getattr(carry0, f) for f in _RO_FIELDS}), ev
+
+    final, events = jax.lax.scan(body, carry0, None, length=num_cycles)
+    return final.replace(**ro), events
 
 
 @functools.partial(jax.jit, static_argnums=(0, 2))
 def run_cycles(cfg: SystemConfig, state: SimState,
                num_cycles: int) -> SimState:
     """Run a fixed number of cycles under lax.scan (bench path)."""
+    carry0, ro = _ro_outside(state)
 
     def body(s, _):
-        return cycle(cfg, s), None
+        out = cycle(cfg, s.replace(**ro))
+        return out.replace(**{f: getattr(carry0, f)
+                              for f in _RO_FIELDS}), None
 
-    state, _ = jax.lax.scan(body, state, None, length=num_cycles)
-    return state
+    final, _ = jax.lax.scan(body, carry0, None, length=num_cycles)
+    return final.replace(**ro)
 
 
 def _run_quiescence(cfg: SystemConfig, state: SimState, chunk: int,
@@ -277,8 +302,12 @@ def _run_quiescence(cfg: SystemConfig, state: SimState, chunk: int,
     final state (tests/test_admission.py pins this).
     """
 
+    carry0, ro = _ro_outside(state)
+
     def body(s, _):
-        return cycle(cfg, s), None
+        out = cycle(cfg, s.replace(**ro))
+        return out.replace(**{f: getattr(carry0, f)
+                              for f in _RO_FIELDS}), None
 
     def cond(s):
         return (~s.quiescent()) & (s.cycle < max_cycles)
@@ -287,7 +316,8 @@ def _run_quiescence(cfg: SystemConfig, state: SimState, chunk: int,
         s, _ = jax.lax.scan(body, s, None, length=chunk)
         return s
 
-    return jax.lax.while_loop(cond, chunk_body, state)
+    final = jax.lax.while_loop(cond, chunk_body, carry0)
+    return final.replace(**ro)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 2))
