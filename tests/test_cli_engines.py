@@ -103,3 +103,23 @@ def test_sweep_seeds_needs_sync_engine(tmp_path, monkeypatch, capsys):
         ["test_3", "--tests-root", REFERENCE_TESTS, "--cpu",
          "--sweep-seeds", "4"], tmp_path, monkeypatch, capsys)
     assert rc == 2 and "--engine sync" in err
+
+
+def test_procedural_cli(tmp_path, monkeypatch, capsys):
+    """--procedural: in-round generated stream, trace-len beyond any
+    stored array, invariant-checked."""
+    rc, _, err = run_cli(
+        ["--engine", "sync", "--procedural", "--nodes", "32",
+         "--trace-len", "400", "--cpu", "--metrics", "--check"],
+        tmp_path, monkeypatch, capsys)
+    assert rc == 0
+    lines = err.strip().splitlines()
+    assert "invariant check passed" in lines[-2]
+    assert json.loads(lines[-1])["instrs_retired"] == 32 * 400
+
+
+def test_procedural_needs_sync(tmp_path, monkeypatch, capsys):
+    rc, _, err = run_cli(
+        ["--procedural", "--nodes", "8", "--cpu"],
+        tmp_path, monkeypatch, capsys)
+    assert rc == 2 and "--engine sync" in err

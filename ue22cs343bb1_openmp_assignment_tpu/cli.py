@@ -115,6 +115,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "async semantics (the differential oracle)")
     p.add_argument("--drain-depth", type=int, default=None,
                    help="sync engine: hit-burst length per round")
+    p.add_argument("--procedural", action="store_true",
+                   help="sync engine: compute the uniform workload "
+                        "procedurally in-round (O(1) trace memory; "
+                        "--trace-len may be arbitrarily long); pairs "
+                        "with --seed as the stream seed")
     p.add_argument("--sweep-seeds", type=int, metavar="K",
                    help="sync engine: run K arbitration seeds as one "
                         "vmapped ensemble and report which seeds "
@@ -199,7 +204,12 @@ def _main_sync(args) -> int:
         dims = dict(num_nodes=args.nodes)
         if args.drain_depth is not None:
             dims["drain_depth"] = args.drain_depth
-        if args.workload:
+        if args.procedural:
+            cfg = SystemConfig.scale(
+                procedural="uniform", max_instrs=1, proc_seed=args.seed,
+                queue_capacity=args.queue_capacity or 64, **dims)
+            st = se.procedural_state(cfg, args.trace_len, seed=seed)
+        elif args.workload:
             cfg = SystemConfig.scale(
                 queue_capacity=args.queue_capacity or 64, **dims)
             system = CoherenceSystem.from_workload(
@@ -219,7 +229,8 @@ def _main_sync(args) -> int:
             print("error: provide <test_directory> or --workload",
                   file=sys.stderr)
             return 2
-        st = se.from_sim_state(cfg, system.state, seed=seed)
+        if not args.procedural:
+            st = se.from_sim_state(cfg, system.state, seed=seed)
 
     if args.sweep_seeds is not None:
         # batched seed sweep over the freshly built machine: one vmapped
@@ -391,6 +402,13 @@ def main(argv=None) -> int:
         import jax
         jax.config.update("jax_platforms", "cpu")
 
+    if args.procedural and args.engine != "sync":
+        print("error: --procedural needs --engine sync", file=sys.stderr)
+        return 2
+    if args.procedural and (args.test_dir or args.workload):
+        print("error: --procedural generates its own stream; drop the "
+              "<test_directory>/--workload argument", file=sys.stderr)
+        return 2
     if args.sweep_seeds and args.engine != "sync":
         print("error: --sweep-seeds is an ensemble sweep on the "
               "transactional engine; add --engine sync", file=sys.stderr)

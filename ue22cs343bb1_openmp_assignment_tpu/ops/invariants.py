@@ -186,19 +186,17 @@ def run_cycles_checked(cfg: SystemConfig, state: SimState,
     """
     import jax
 
-    from ue22cs343bb1_openmp_assignment_tpu.ops.step import (_RO_FIELDS,
-                                                             _ro_outside,
+    from ue22cs343bb1_openmp_assignment_tpu.ops.step import (_ro_outside,
                                                              cycle)
 
-    carry_state0, ro = _ro_outside(state)
+    carry_state0, ro, blanks = _ro_outside(state)
 
     def body(carry, _):
         s, acc = carry
         s = cycle(cfg, s.replace(**ro))
         v = step_violations(cfg, s)
         acc = {k: acc[k] + v[k] for k in acc}
-        s = s.replace(**{f: getattr(carry_state0, f) for f in _RO_FIELDS})
-        return (s, acc), None
+        return (s.replace(**blanks), acc), None
 
     zero = {k: jnp.zeros((), jnp.int32)
             for k in step_violations(cfg, state)}

@@ -242,17 +242,19 @@ _RO_FIELDS = ("instr_op", "instr_addr", "instr_val", "issue_delay",
 
 
 def _ro_outside(state: SimState):
-    """(loop-carry state, dict of hoisted read-only fields): large
+    """(loop-carry state, real-fields dict, placeholders dict): large
     read-only arrays in a scan/while carry get copied every iteration
     when XLA cannot prove aliasing (PERF.md) — the instruction trace and
     schedule knobs never change during a run, so the loops carry
-    zero-width placeholders and bodies close over the real arrays."""
+    zero-width placeholders and bodies close over the real arrays
+    (restore with .replace(**ro) before cycle, re-blank with
+    .replace(**placeholders) after)."""
     ro = {f: getattr(state, f) for f in _RO_FIELDS}
     placeholders = {
         f: jnp.zeros(v.shape[:-1] + (0,), v.dtype) if v.ndim > 1
         else jnp.zeros((0,), v.dtype)
         for f, v in ro.items()}
-    return state.replace(**placeholders), ro
+    return state.replace(**placeholders), ro, placeholders
 
 
 @functools.partial(jax.jit, static_argnums=(0, 2))
@@ -266,11 +268,11 @@ def run_cycles_traced(cfg: SystemConfig, state: SimState,
     ``instruction_order.txt`` line format).
     """
 
-    carry0, ro = _ro_outside(state)
+    carry0, ro, blanks = _ro_outside(state)
 
     def body(s, _):
         out, ev = cycle(cfg, s.replace(**ro), with_events=True)
-        return out.replace(**{f: getattr(carry0, f) for f in _RO_FIELDS}), ev
+        return out.replace(**blanks), ev
 
     final, events = jax.lax.scan(body, carry0, None, length=num_cycles)
     return final.replace(**ro), events
@@ -280,12 +282,11 @@ def run_cycles_traced(cfg: SystemConfig, state: SimState,
 def run_cycles(cfg: SystemConfig, state: SimState,
                num_cycles: int) -> SimState:
     """Run a fixed number of cycles under lax.scan (bench path)."""
-    carry0, ro = _ro_outside(state)
+    carry0, ro, blanks = _ro_outside(state)
 
     def body(s, _):
         out = cycle(cfg, s.replace(**ro))
-        return out.replace(**{f: getattr(carry0, f)
-                              for f in _RO_FIELDS}), None
+        return out.replace(**blanks), None
 
     final, _ = jax.lax.scan(body, carry0, None, length=num_cycles)
     return final.replace(**ro)
@@ -302,12 +303,11 @@ def _run_quiescence(cfg: SystemConfig, state: SimState, chunk: int,
     final state (tests/test_admission.py pins this).
     """
 
-    carry0, ro = _ro_outside(state)
+    carry0, ro, blanks = _ro_outside(state)
 
     def body(s, _):
         out = cycle(cfg, s.replace(**ro))
-        return out.replace(**{f: getattr(carry0, f)
-                              for f in _RO_FIELDS}), None
+        return out.replace(**blanks), None
 
     def cond(s):
         return (~s.quiescent()) & (s.cycle < max_cycles)
