@@ -32,15 +32,20 @@ def make_sharded_cycle(cfg: SystemConfig, mesh, example_state):
 def make_sharded_runner(cfg: SystemConfig, mesh, example_state,
                         num_cycles: int):
     """jit a `num_cycles`-cycle scan with node-axis shardings."""
+    from ue22cs343bb1_openmp_assignment_tpu.ops.step import _ro_outside
     sh = state_shardings(cfg, mesh, example_state)
 
-    def body(s, _):
-        return cycle(cfg, s), None
-
     @functools.partial(jax.jit, in_shardings=(sh,), out_shardings=sh)
-    def run(s):
-        s, _ = jax.lax.scan(body, s, None, length=num_cycles)
-        return s
+    def run(state):
+        # read-only arrays stay out of the scan carry (ops.step hoist)
+        carry0, ro, blanks = _ro_outside(state)
+
+        def body(s, _):
+            out = cycle(cfg, s.replace(**ro))
+            return out.replace(**blanks), None
+
+        final, _ = jax.lax.scan(body, carry0, None, length=num_cycles)
+        return final.replace(**ro)
 
     return run
 
