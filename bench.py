@@ -36,8 +36,16 @@ def main():
                          "(32 measured best on the attached device)")
     ap.add_argument("--workload", default="uniform")
     ap.add_argument("--local-frac", type=float, default=0.8)
-    ap.add_argument("--drain-depth", type=int, default=16,
-                    help="sync engine: hit-burst length per round")
+    ap.add_argument("--drain-depth", type=int, default=None,
+                    help="sync engine: hit budget per round (default: "
+                         "16 for --txn-width 1, else 4 — both measured "
+                         "best on the attached device)")
+    ap.add_argument("--txn-width", type=int, default=None,
+                    help="sync engine: max coherence transactions "
+                         "committed per node per round (multi-"
+                         "transaction window; 1 = classic burst-plus-"
+                         "one-transaction rounds; default 3, measured "
+                         "best)")
     ap.add_argument("--replicas", type=int, default=1,
                     help="sync engine: independent machines batched into "
                          "one ensemble (different workload + arbitration "
@@ -79,9 +87,18 @@ def main():
     if args.smoke:
         args.nodes, args.trace_len, args.chunk = 64, 8, 8
 
+    if args.txn_width is not None and args.engine != "sync":
+        print("error: --txn-width sizes the sync engine's multi-"
+              "transaction window; use --engine sync", file=sys.stderr)
+        return 2
+    if args.txn_width is None:
+        args.txn_width = 3 if args.engine == "sync" else 1
+    if args.drain_depth is None:
+        args.drain_depth = 16 if args.txn_width == 1 else 4
     cfg = SystemConfig.scale(num_nodes=args.nodes,
                              admission_window=args.admission,
-                             drain_depth=args.drain_depth)
+                             drain_depth=args.drain_depth,
+                             txn_width=args.txn_width)
     if args.procedural and (args.engine != "sync"
                             or args.workload != "uniform"
                             or args.replicas > 1):

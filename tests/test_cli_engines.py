@@ -123,3 +123,29 @@ def test_procedural_needs_sync(tmp_path, monkeypatch, capsys):
         ["--procedural", "--nodes", "8", "--cpu"],
         tmp_path, monkeypatch, capsys)
     assert rc == 2 and "--engine sync" in err
+
+
+@requires_reference
+def test_txn_width_byte_exact_and_checked(tmp_path, monkeypatch, capsys):
+    """Multi-transaction windows through the CLI: byte parity plus the
+    exact-directory invariant on a deterministic suite."""
+    rc, _, err = run_cli(
+        ["test_1", "--tests-root", REFERENCE_TESTS, "--cpu",
+         "--engine", "sync", "--txn-width", "4", "--check",
+         "--metrics"], tmp_path, monkeypatch, capsys)
+    assert rc == 0
+    lines = err.strip().splitlines()
+    assert "invariant check passed" in lines[-2]
+    assert json.loads(lines[-1])["instrs_retired"] == 68
+    for n in range(4):
+        got = (tmp_path / f"core_{n}_output.txt").read_text()
+        golden = open(
+            f"{REFERENCE_TESTS}/test_1/core_{n}_output.txt").read()
+        assert got == golden, f"txn-width core_{n} diverged"
+
+
+def test_txn_width_needs_sync(tmp_path, monkeypatch, capsys):
+    rc, _, err = run_cli(
+        ["--workload", "uniform", "--nodes", "8", "--cpu",
+         "--txn-width", "3"], tmp_path, monkeypatch, capsys)
+    assert rc == 2 and "--engine sync" in err

@@ -115,6 +115,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "async semantics (the differential oracle)")
     p.add_argument("--drain-depth", type=int, default=None,
                    help="sync engine: hit-burst length per round")
+    p.add_argument("--txn-width", type=int, default=None,
+                   help="sync engine: max coherence transactions "
+                        "committed per node per round (multi-"
+                        "transaction windows; default 1 = classic "
+                        "burst-plus-one-transaction rounds)")
     p.add_argument("--procedural", action="store_true",
                    help="sync engine: compute the uniform workload "
                         "procedurally in-round (O(1) trace memory; "
@@ -192,18 +197,25 @@ def _main_sync(args) -> int:
             print("error: checkpoint was written by the async engine; "
                   "resume it without --engine sync", file=sys.stderr)
             return 2
-        if args.drain_depth is not None:
-            # pure compute knob (burst window; no state shapes depend on
-            # it) — overridable on resume like the async path's
+        if args.drain_depth is not None or args.txn_width is not None:
+            # pure compute knobs (window shape; no state shapes depend
+            # on them) — overridable on resume like the async path's
             # admission/drop knobs
             import dataclasses as _dc
-            cfg = _dc.replace(cfg, drain_depth=args.drain_depth)
+            over = {}
+            if args.drain_depth is not None:
+                over["drain_depth"] = args.drain_depth
+            if args.txn_width is not None:
+                over["txn_width"] = args.txn_width
+            cfg = _dc.replace(cfg, **over)
         if args.arb_seed is not None:
             st = st.replace(seed=np.int32(args.arb_seed))
     else:
         dims = dict(num_nodes=args.nodes)
         if args.drain_depth is not None:
             dims["drain_depth"] = args.drain_depth
+        if args.txn_width is not None:
+            dims["txn_width"] = args.txn_width
         if args.procedural:
             cfg = SystemConfig.scale(
                 procedural="uniform", max_instrs=1, proc_seed=args.seed,
@@ -412,6 +424,11 @@ def main(argv=None) -> int:
     if args.sweep_seeds and args.engine != "sync":
         print("error: --sweep-seeds is an ensemble sweep on the "
               "transactional engine; add --engine sync", file=sys.stderr)
+        return 2
+    if args.txn_width is not None and args.engine != "sync":
+        print("error: --txn-width sizes the transactional engine's "
+              "multi-transaction window; add --engine sync",
+              file=sys.stderr)
         return 2
     if args.engine == "sync":
         return _main_sync(args)

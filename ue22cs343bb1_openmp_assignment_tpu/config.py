@@ -56,6 +56,19 @@ class SystemConfig:
     # depth realizes a legal schedule.
     drain_depth: int = 4
 
+    # Transaction-window width of the synchronous transactional engine:
+    # per round each node may commit up to this many coherence
+    # transactions (read-miss / write-miss / upgrade), provided they
+    # touch pairwise-distinct directory entries (fill targets and evicted
+    # victims alike); mid-window cache hits retire only on entries the
+    # node itself claimed earlier in the window, which keeps every
+    # committed round a legal serialization of the reference machine
+    # (ops/sync_engine.py `_round_step_multi` docstring). 1 = the
+    # classic burst-plus-one-transaction round. Purely a throughput
+    # knob: the per-round device-dispatch cost is roughly constant, so
+    # wider windows retire more instructions per dispatch.
+    txn_width: int = 1
+
     # Procedural workload (sync engine): when set (e.g. "uniform"),
     # instructions are computed per (node, index) from a counter-based
     # hash inside the round instead of gathered from a stored [N, T]
@@ -81,6 +94,8 @@ class SystemConfig:
     def __post_init__(self):
         if self.num_nodes < 1:
             raise ValueError("num_nodes must be >= 1")
+        if self.txn_width < 1:
+            raise ValueError("txn_width must be >= 1")
         if self.inv_mode not in ("mailbox", "scatter"):
             raise ValueError(f"bad inv_mode {self.inv_mode!r}")
         if self.inv_mode == "mailbox" and self.num_nodes > 64:

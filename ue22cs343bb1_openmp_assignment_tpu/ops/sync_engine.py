@@ -357,8 +357,40 @@ def _mix(x: jnp.ndarray) -> jnp.ndarray:
     return x
 
 
+def _round_key(cfg: SystemConfig, st: SyncState, rows: jnp.ndarray):
+    """Per-round claim key: decreasing round countdown in the high bits,
+    a reseeded bijective node-priority permutation in the low bits (see
+    the DM_CLAIM comment at the top). Keys are unique per node."""
+    N = cfg.num_nodes
+    prio_bits = max(1, (N - 1).bit_length())
+    mask = jnp.uint32((1 << prio_bits) - 1)
+    h = _mix((st.round.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
+             ^ (st.seed.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)))
+    x = rows.astype(jnp.uint32)
+    x = (x * ((h << 1) | jnp.uint32(1)) + (h >> 7)) & mask
+    x ^= x >> max(1, prio_bits // 2)
+    x = (x * jnp.uint32(0x9E3779B9 | 1)) & mask
+    prio = x.astype(jnp.int32)
+    countdown = jnp.maximum(claim_max_rounds(cfg) - st.round, 0)
+    return (countdown << prio_bits) | prio
+
+
 def round_step(cfg: SystemConfig, st: SyncState,
                with_events: bool = False):
+    """One transactional round; dispatches on cfg.txn_width.
+
+    txn_width == 1: the classic hit-burst plus one atomic transaction
+    per node (`_round_step_single`). txn_width > 1: a window of up to
+    txn_width transactions per node commits per round
+    (`_round_step_multi`) — same protocol, more progress per device
+    dispatch."""
+    if cfg.txn_width == 1:
+        return _round_step_single(cfg, st, with_events)
+    return _round_step_multi(cfg, st, with_events)
+
+
+def _round_step_single(cfg: SystemConfig, st: SyncState,
+                       with_events: bool = False):
     """Advance every node by one burst of hits plus one transaction.
 
     ``with_events=True`` additionally returns this round's retirement
@@ -461,22 +493,10 @@ def round_step(cfg: SystemConfig, st: SyncState,
     # per-round priority permutation: an affine-xorshift bijection on
     # prio_bits bits (odd multiplier => bijective mod 2^b; xorshift is
     # invertible), reseeded every round — pairwise-fair arbitration, the
-    # stand-in for OS lock order. Injective on node ids, so keys are
-    # unique.
-    prio_bits = max(1, (N - 1).bit_length())
-    mask = jnp.uint32((1 << prio_bits) - 1)
-    h = _mix((st.round.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
-             ^ (st.seed.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)))
-    x = rows.astype(jnp.uint32)
-    x = (x * ((h << 1) | jnp.uint32(1)) + (h >> 7)) & mask
-    x ^= x >> max(1, prio_bits // 2)
-    x = (x * jnp.uint32(0x9E3779B9 | 1)) & mask
-    prio = x.astype(jnp.int32)
-    # decreasing round countdown in the high bits (DM_CLAIM comment);
-    # clamped so overrunning the budget degrades to stale-claim stalls,
-    # never int32 wraparound
-    countdown = jnp.maximum(claim_max_rounds(cfg) - st.round, 0)
-    key = (countdown << prio_bits) | prio
+    # stand-in for OS lock order. Keys are unique per node; the round
+    # countdown in the high bits is clamped so overrunning the budget
+    # degrades to stale-claim stalls, never int32 wraparound.
+    key = _round_key(cfg, st, rows)
     c_idx = jnp.concatenate([jnp.where(txn, e1, E),
                              jnp.where(has_victim, e2, E)])
     dm_claimed = st.dm.at[c_idx, DM_CLAIM].min(
@@ -609,6 +629,465 @@ def round_step(cfg: SystemConfig, st: SyncState,
     slot_retired = (offs < d[:, None]) | ((offs == d[:, None])
                                           & win[:, None])
     events = {"retired": slot_retired, "op": w_op, "addr": w_addr,
+              "value": w_val}
+    return new_st, events
+
+
+def _round_step_multi(cfg: SystemConfig, st: SyncState,
+                      with_events: bool = False):
+    """Advance every node by a window of up to cfg.txn_width transactions.
+
+    Generalizes `_round_step_single` from burst-plus-one-transaction to a
+    per-node window of W = drain_depth + txn_width instructions, within
+    which up to K = txn_width coherence transactions commit in one round.
+    The admission rules keep every committed round a legal serialization
+    of the reference machine (same argument shape as the single-txn
+    round, SURVEY §3.2-3.5):
+
+    * **Distinct entries.** All directory entries a node's window touches
+      — transaction targets and evicted victims alike — must be pairwise
+      distinct; a repeat stops the window. Combined with claim
+      arbitration (one winner per entry per round), every committed
+      transaction reads a directory row no other committed transaction
+      touches, so all outcomes may be computed from round-start rows.
+      Two relaxations cover the common working-set-cycling patterns of
+      small direct-mapped caches; both compose a node's multiple updates
+      to one entry into a single scattered row, so each entry still has
+      exactly one committed writer:
+
+      - **Release**: a transaction may displace a line the node filled
+        earlier in the same window; the entry's final row is the acquire
+        outcome followed by the self-eviction (`released` below).
+      - **Reacquire**: a transaction may target an entry whose line the
+        node itself evicted earlier in the window, provided the
+        displaced line was MODIFIED or EXCLUSIVE — then the node was its
+        sole holder and the eviction provably left the entry Uncached
+        with known memory, so the reacquire proceeds from that composed
+        row (`acq_base` below) and the evict's separate victim row is
+        suppressed. Evicting a SHARED line may instead leave an
+        EM entry whose owner (the promoted last sharer) is unknown at
+        composition time, so reacquiring after a SHARED evict stops the
+        window, as does any deeper chain on one entry.
+    * **Hit admission.** Hits before the node's first transaction (the
+      classic burst) retire unconditionally — they serialize before all
+      transactions, as in the single-txn round. Mid-window hits after
+      the first transaction retire when (a) the node itself claimed the
+      entry earlier in the window, or (b) post-claim, the entry carries
+      no fresh transaction claim this round (checked against the claim
+      column, no extra scatter — hits place no claims). Either way no
+      foreign transaction commits on the entry this round, so committed
+      windows touch pairwise-disjoint entries and ANY interleaving that
+      respects per-node program order — prefix hits first, then whole
+      windows node by node — is a legal serialization. An interior hit
+      whose entry does carry a foreign claim truncates retirement at
+      its window position, exactly like a losing transaction (a foreign
+      kill might otherwise have to land between our program-ordered
+      reads, which may admit no consistent order).
+    * **Truncation.** A transaction that loses claim arbitration
+      truncates retirement at its window position: nothing after it
+      retires, so the retired stream is always a program-order prefix.
+      Progress: the globally minimal-priority node wins every claim it
+      makes, so its whole window commits.
+    * **Read-fill ambiguity.** A read-miss fill's final state (E vs S)
+      depends on the directory row, unknown during the sequential fold;
+      the fold records it as SHARED (a reacquire-after-evict fill is
+      provably EXCLUSIVE and recorded as such). A later write to an
+      ambiguous fill becomes a **dependent hit**: it retires iff the
+      fill resolves EXCLUSIVE post-claim (then it is a silent E->M write
+      hit, no directory effect); a SHARED resolution would need an
+      upgrade transaction, so it truncates retirement at the write's
+      window position instead — the ambiguity never reaches a commit.
+
+    Per-round device work matches the single path (one claim
+    scatter-min, one row gather, one commit scatter, one fan-out gather,
+    one promotion scatter) with K-times larger index vectors — on a
+    dispatch-bound device the round cost is nearly flat while retiring
+    up to K transactions per node (PERF.md).
+    """
+    N, C = cfg.num_nodes, cfg.cache_size
+    K = cfg.txn_width
+    W = cfg.drain_depth + K
+    T = st.instr_pack.shape[1]
+    E = N << cfg.block_bits
+    rows = jnp.arange(N, dtype=jnp.int32)
+    INV = int(CacheState.INVALID)
+    MOD = int(CacheState.MODIFIED)
+    EXC = int(CacheState.EXCLUSIVE)
+    SHD = int(CacheState.SHARED)
+    idx0 = st.idx
+
+    # ---- instruction window ----------------------------------------------
+    offs = jnp.arange(W, dtype=jnp.int32)[None, :]
+    w_idx = idx0[:, None] + offs
+    w_live = w_idx < st.instr_count[:, None]
+    if cfg.procedural:
+        w_oa, w_val = procedural_instr(cfg, rows[:, None], w_idx)
+    else:
+        w_flat = rows[:, None] * T + jnp.minimum(w_idx, T - 1)
+        w = st.instr_pack.reshape(N * T, 2)[w_flat]
+        w_oa, w_val = w[..., 0], w[..., 1]
+    w_op, w_addr = w_oa >> 28, w_oa & 0x0FFFFFFF
+    w_ci = codec.cache_index(cfg, w_addr)
+    c_iota = jnp.arange(C, dtype=jnp.int32)
+
+    def line_select(ci, *arrs):
+        """Read each node's line `ci` from [N, C] arrays via a chain of
+        selects — no reduction, so the whole fold stays fusable."""
+        outs = [a[:, 0] for a in arrs]
+        for c in range(1, C):
+            m = ci == c
+            outs = [jnp.where(m, a[:, c], o) for a, o in zip(arrs, outs)]
+        return outs
+
+    # ---- sequential pre-claim fold (static unroll, all elementwise) ------
+    ca_f, cv_f, cs_f = st.cache_addr, st.cache_val, st.cache_state
+    cv_pre = cv_f                     # cache values at the first-txn point
+    frozen = jnp.zeros((N,), bool)    # node has issued a txn this window
+    stopped = jnp.zeros((N,), bool)
+    n_txn = jnp.zeros((N,), jnp.int32)
+    fills: list = []                  # (entry, valid, ordinal) fill targets
+    victs: list = []                  # (entry, valid, ordinal, eligible)
+    steps: list = []
+    # per-line ordinal of the window read-fill holding it (K = none):
+    # writes to such lines are tentative hits, resolved post-claim
+    fo_f = jnp.full((N, C), K, jnp.int32)
+    for k in range(W):
+        addr, op, val = w_addr[:, k], w_op[:, k], w_val[:, k]
+        live = w_live[:, k]
+        onehot = w_ci[:, k][:, None] == c_iota[None, :]          # [N, C]
+        l_addr, l_val, l_state, l_fo = line_select(
+            w_ci[:, k], ca_f, cv_f, cs_f, fo_f)
+        tag_ok = (l_addr == addr) & (l_state != INV)
+        is_rd, is_wr = op == int(Op.READ), op == int(Op.WRITE)
+        rd_hit = live & is_rd & tag_ok
+        wr_hit = live & is_wr & tag_ok & ((l_state == MOD)
+                                          | (l_state == EXC))
+        # write on an own window read-fill (tentative SHARED): a
+        # tentative hit, resolved post-claim against the fill's d_u
+        wr_dep = live & is_wr & tag_ok & (l_state == SHD) & (l_fo < K)
+        hit = rd_hit | wr_hit | wr_dep | (live & (op == int(Op.NOP)))
+        upg = live & is_wr & tag_ok & (l_state == SHD) & (l_fo == K)
+        rd_miss = live & is_rd & ~tag_ok
+        wr_miss = live & is_wr & ~tag_ok
+        e1 = jnp.clip(addr, 0, E - 1)
+        has_victim = ~tag_ok & (l_state != INV) & (l_addr != addr)
+        e2 = jnp.clip(l_addr, 0, E - 1)
+        own1 = jnp.zeros((N,), bool)  # e1 already claimed by this node
+        dup = jnp.zeros((N,), bool)   # e1 re-touches a window entry
+        rel_ord = jnp.full((N,), K, jnp.int32)  # own fill being displaced
+        acq_base = jnp.full((N,), K, jnp.int32)  # reacquire-after-evict
+        for te, tv, tord in fills:
+            own1 |= tv & (te == e1)
+            dup |= tv & (te == e1)
+            # displacing a prior fill is a release (rows compose); prior
+            # victims can never be displaced again (their tag left the
+            # cache), so only fills need checking against e2
+            rel_ord = jnp.where(tv & has_victim & (te == e2), tord,
+                                rel_ord)
+        for te, tv, tord, telig in victs:
+            m = tv & (te == e1)
+            dup |= m & ~telig         # reacquire after a SHARED evict
+            acq_base = jnp.where(m & telig, tord, acq_base)
+        # interior hits on unclaimed entries retire tentatively; their
+        # safety (no fresh foreign claim on the entry) resolves
+        # post-claim and truncates on failure
+        hc = hit & ~stopped & frozen & ~own1
+        hit_ok = (hit & ~stopped & (~frozen | own1)) | hc
+        txn = (rd_miss | wr_miss | upg) & ~stopped
+        ok = txn & ~dup & (n_txn < K)
+        rel_ord = jnp.where(ok, rel_ord, K)
+        acq_base = jnp.where(ok, acq_base, K)
+        stop_now = ~hit_ok & ~ok & ~stopped
+        # hit-write effects (last write wins; any write leaves MODIFIED)
+        wmask = ((wr_hit | wr_dep) & hit_ok)[:, None] & onehot
+        cv_f = jnp.where(wmask, val[:, None], cv_f)
+        cs_f = jnp.where(wmask, MOD, cs_f)
+        # prefix cache freezes at the node's first issued transaction;
+        # it is what foreign transactions observe of this node (the
+        # single path's "post-burst" owner-value source)
+        cv_pre = jnp.where(frozen[:, None], cv_pre, cv_f)
+        frozen = frozen | ok
+        # tentative fill: tag always; value only for write-like fills
+        # (a read fill's value is resolved post-claim and — by the
+        # distinctness rule — never read back inside this window)
+        fmask = ok[:, None] & onehot
+        ca_f = jnp.where(fmask, addr[:, None], ca_f)
+        cv_f = jnp.where((ok & (wr_miss | upg))[:, None] & onehot,
+                         val[:, None], cv_f)
+        # a reacquire-rd provably fills EXCLUSIVE (the composed entry is
+        # Uncached), so record it as such — a later write then hits
+        cs_f = jnp.where(fmask,
+                         jnp.where((wr_miss | upg)[:, None], MOD,
+                                   jnp.where((acq_base < K)[:, None],
+                                             EXC, SHD)),
+                         cs_f)
+        # non-reacquire read fills are E/S-ambiguous: record the line's
+        # fill ordinal so later writes to it become dependent hits
+        fo_f = jnp.where(fmask,
+                         jnp.where((ok & rd_miss & (acq_base == K)),
+                                   n_txn, K)[:, None],
+                         fo_f)
+        steps.append(dict(
+            hit_ok=hit_ok, rd_hit=rd_hit & hit_ok,
+            wr_hit=(wr_hit | wr_dep) & hit_ok,
+            dep=jnp.where(wr_dep & hit_ok, l_fo, K),
+            ok=ok, ordn=jnp.where(ok, n_txn, K), addr=addr, val=val,
+            e1=e1, e2=e2, victim=ok & has_victim, rd=ok & rd_miss,
+            wr=ok & wr_miss, up=ok & upg, v_val=l_val,
+            v_mod=l_state == MOD, rel_ordn=rel_ord, acq_basen=acq_base,
+            hc=hc, onehot=onehot))
+        fills.append((e1, ok, n_txn))
+        # a victim is reacquirable when the displaced line was M/E (the
+        # node was sole holder -> the evict leaves the entry Uncached)
+        # and it was the entry's first touch (not a release)
+        victs.append((e2, ok & has_victim,
+                      n_txn, ((l_state == MOD) | (l_state == EXC))
+                      & (rel_ord == K)))
+        n_txn = n_txn + ok
+        stopped = stopped | stop_now
+
+    # ---- pack transactions into [N, K] ordinal slots ---------------------
+    sel = [[steps[k]["ordn"] == j for k in range(W)] for j in range(K)]
+
+    def pack(name):
+        return jnp.stack(
+            [sum(jnp.where(sel[j][k], steps[k][name], 0)
+                 for k in range(W)) for j in range(K)], axis=1)
+
+    exists = pack("ok").astype(bool)                              # [N, K]
+    e1_s, e2_s = pack("e1"), pack("e2")
+    val_s, v_val_s = pack("val"), pack("v_val")
+    victim_s = pack("victim").astype(bool)
+    rd_s, wr_s, up_s = (pack("rd").astype(bool), pack("wr").astype(bool),
+                        pack("up").astype(bool))
+    v_mod_s = pack("v_mod").astype(bool)
+    # releasing slot r displaces the fill of slot rel_s[:, r] (K = none)
+    rel_s = jnp.where(exists, pack("rel_ordn"), K)
+    pos_s = jnp.stack(
+        [sum(jnp.where(sel[j][k], k, 0) for k in range(W))
+         for j in range(K)], axis=1)                              # [N, K]
+
+    # ---- claim + win resolution ------------------------------------------
+    key = _round_key(cfg, st, rows)
+    c_idx = jnp.concatenate(
+        [jnp.where(exists[:, j], e1_s[:, j], E) for j in range(K)]
+        + [jnp.where(victim_s[:, j], e2_s[:, j], E) for j in range(K)])
+    dm_claimed = st.dm.at[c_idx, DM_CLAIM].min(jnp.tile(key, 2 * K),
+                                               mode="drop")
+    # ONE row gather serves the txn entries, the victim entries, and the
+    # interior-hit safety probes
+    he = jnp.stack([steps[k]["e1"] for k in range(W)], axis=1)    # [N, W]
+    g = dm_claimed[jnp.concatenate([e1_s, e2_s, he], axis=1)]
+    d1, d2, hrow = g[:, :K], g[:, K:2 * K], g[:, 2 * K:]
+    keyK = key[:, None]
+    win = exists & (d1[..., DM_CLAIM] == keyK) & (
+        ~victim_s | (d2[..., DM_CLAIM] == keyK))
+    # interior-hit safety: the hit's entry carries no fresh foreign
+    # transaction claim (fresh keys this round sit strictly below every
+    # stale key — the DM_CLAIM countdown invariant)
+    prio_bits = max(1, (N - 1).bit_length())
+    thresh = (jnp.maximum(claim_max_rounds(cfg) - st.round, 0) + 1) \
+        << prio_bits
+    hgot = hrow[..., DM_CLAIM]                                    # [N, W]
+    hc_k = jnp.stack([steps[k]["hc"] for k in range(W)], axis=1)
+    h_unsafe = hc_k & ~((hgot >= thresh) | (hgot == keyK))
+
+    # ---- effective primary rows (before commit: truncation needs d_u) ----
+    d1s, d1c, d1o, d1m = (d1[..., DM_STATE], d1[..., DM_COUNT],
+                          d1[..., DM_OWNER], d1[..., DM_MEM])
+    d2c, d2o, d2m = d2[..., DM_COUNT], d2[..., DM_OWNER], d2[..., DM_MEM]
+    v_mod_s = v_mod_s & victim_s
+    # reacquires chain off the base slot's post-evict row instead of the
+    # gathered round-start row: always Uncached (the eligibility rule),
+    # memory = the evict's outcome (the flushed value for an M line)
+    acqb_s = jnp.where(exists, pack("acq_basen"), K)
+    pe_m = jnp.where(v_mod_s, v_val_s, d2m)     # [N, K] post-evict memory
+    j_iota = jnp.arange(K, dtype=jnp.int32)[None, :]
+    base_u = jnp.zeros((N, K), bool)
+    base_m = jnp.zeros((N, K), jnp.int32)
+    for i in range(K):
+        m = acqb_s == i
+        base_u |= m
+        base_m = jnp.where(m, pe_m[:, i:i + 1], base_m)
+    d1s = jnp.where(base_u, int(DirState.U), d1s)
+    d1c = jnp.where(base_u, 0, d1c)
+    d1m = jnp.where(base_u, base_m, d1m)
+    d_u = d1s == int(DirState.U)
+    d_em = d1s == int(DirState.EM)
+
+    # tentative writes on own read fills retire iff the fill resolved
+    # EXCLUSIVE (entry Uncached at acquire) — a silent E->M write hit;
+    # a SHARED resolution would need an upgrade, so it truncates
+    dep_k = jnp.stack([steps[k]["dep"] for k in range(W)], axis=1)
+    dep_ok = jnp.zeros((N, W), bool)
+    for j in range(K):
+        dep_ok |= (dep_k == j) & d_u[:, j:j + 1]
+    w_iota = jnp.arange(W, dtype=jnp.int32)[None, :]
+    first_bad_hit = jnp.min(
+        jnp.where(h_unsafe | ((dep_k < K) & ~dep_ok), w_iota, W),
+        axis=1)                                                   # [N]
+    # committed = the leading prefix of transactions that win their
+    # claims and sit before any unsafe interior hit; the first loss (or
+    # unsafe hit) truncates retirement at its window position
+    eligible = win & (pos_s < first_bad_hit[:, None])
+    cum = jnp.cumprod((eligible | ~exists).astype(jnp.int32),
+                      axis=1).astype(bool)
+    commit = exists & cum
+    first_lose = jnp.minimum(
+        jnp.min(jnp.where(exists & ~cum, pos_s, W), axis=1),
+        first_bad_hit)                                            # [N]
+
+    # ---- transaction outcomes (round-start rows; entries disjoint) -------
+    rd_w, wr_w, up_w = commit & rd_s, commit & wr_s, commit & up_s
+    wlike = wr_w | up_w
+    ci_s = codec.cache_index(cfg, e1_s)
+    safe_o = jnp.clip(d1o, 0, N - 1)
+    val_o = cv_pre.reshape(-1)[safe_o * C + ci_s]                 # [N, K]
+    n1s = jnp.where(wlike | (rd_w & d_u), int(DirState.EM),
+                    int(DirState.S))
+    n1c = jnp.where(wlike | (rd_w & d_u), 1,
+                    jnp.where(rd_w & d_em, 2, d1c + 1))
+    n1o = jnp.where(wlike | (rd_w & d_u), rows[:, None], d1o)
+    n1m = jnp.where((rd_w | wr_w) & d_em, val_o, d1m)
+    act1 = jnp.where(wlike, ACT_KILL,
+                     jnp.where(rd_w & d_em, ACT_DOWNGRADE, ACT_NONE))
+    ev = commit & victim_s
+    ev_mod = ev & v_mod_s
+    ev_sh = ev & ~ev_mod
+    n2c = jnp.where(ev_mod, 0, d2c - 1)
+    n2s = jnp.where(n2c == 0, int(DirState.U),
+                    jnp.where(n2c == 1, int(DirState.EM), int(DirState.S)))
+    n2m = jnp.where(ev_mod, v_val_s, d2m)
+    act2 = jnp.where(ev_sh & (n2c == 1), ACT_PROMOTE, ACT_NONE)
+
+    # ---- release composition: fill-then-self-evict as one row ------------
+    # A committed txn r whose victim is slot j's own fill (rel_s[:,r]==j)
+    # releases slot j: entry e1_j's final row is the acquire outcome
+    # followed by the self-eviction, written by slot j's scatter alone.
+    released = jnp.zeros((N, K), bool)
+    rel_val = jnp.zeros((N, K), jnp.int32)  # line value at displacement
+    rel_dirty = jnp.zeros((N, K), bool)     # line MODIFIED at displacement
+    consumed = jnp.zeros((N, K), bool)      # victim row superseded by a
+    for r in range(K):                      # committed reacquire
+        m = commit[:, r:r + 1] & (rel_s[:, r:r + 1] == j_iota)    # [N, K]
+        released |= m
+        rel_val = jnp.where(m, v_val_s[:, r:r + 1], rel_val)
+        rel_dirty |= m & v_mod_s[:, r:r + 1]
+        consumed |= commit[:, r:r + 1] & (acqb_s[:, r:r + 1] == j_iota)
+    rd_rel_s = released & rd_s & ~d_u & ~d_em                     # rd on S
+    r1s = jnp.where(wlike | (rd_s & d_u), int(DirState.U),
+                    jnp.where(rd_s & d_em, int(DirState.EM),
+                              jnp.where(d1c == 1, int(DirState.EM),
+                                        int(DirState.S))))
+    r1c = jnp.where(wlike | (rd_s & d_u), 0,
+                    jnp.where(rd_s & d_em, 1, d1c))
+    # rel_dirty: a read fill written via a dependent hit (E->M) before
+    # displacement flushes the written value, like a MODIFIED evict
+    r1m = jnp.where(wlike | rel_dirty, rel_val,
+                    jnp.where(rd_s & d_em, val_o, d1m))
+    r1a = jnp.where(wlike, ACT_KILL,
+                    jnp.where((rd_s & d_em) | (rd_rel_s & (d1c == 1)),
+                              ACT_PROMOTE, ACT_NONE))
+    n1s = jnp.where(released, r1s, n1s)
+    n1c = jnp.where(released, r1c, n1c)
+    n1o = jnp.where(released, d1o, n1o)
+    n1m = jnp.where(released, r1m, n1m)
+    act1 = jnp.where(released, r1a, act1)
+    # a release's victim row rides in slot j's composed scatter, and a
+    # reacquired entry's row is written by the reacquiring slot alone;
+    # only unconsumed first-touch victims get their own row
+    ev_sep = ev & (rel_s == K) & ~consumed
+
+    # ---- commit: one packed scatter for all entries ----------------------
+    rtag = st.round << 2
+    rowsK = jnp.broadcast_to(rows[:, None], (N, K))
+    keyKb = jnp.broadcast_to(keyK, (N, K))
+    t_idx = jnp.concatenate([jnp.where(commit, e1_s, E).reshape(-1),
+                             jnp.where(ev_sep, e2_s, E).reshape(-1)])
+    t_dm = jnp.concatenate([
+        jnp.stack([n1s, n1c, n1o, n1m, rtag | act1, rowsK, keyKb],
+                  axis=-1).reshape(-1, DM_COLS),
+        jnp.stack([n2s, n2c, d2o, n2m, rtag | act2, rowsK, keyKb],
+                  axis=-1).reshape(-1, DM_COLS)])
+    dm = dm_claimed.at[t_idx].set(t_dm, mode="drop")
+
+    # ---- replay: apply the retired prefix to the round-start cache -------
+    fill_state = jnp.where(rd_s, jnp.where(d_u, EXC, SHD), MOD)   # [N, K]
+    fill_val = jnp.where(rd_s, jnp.where(d_em, val_o, d1m), val_s)
+    ca_c, cv_c, cs_c = st.cache_addr, st.cache_val, st.cache_state
+    retired_ks, rh_ks, wh_ks = [], [], []
+    for k in range(W):
+        s = steps[k]
+        r = (k < first_lose) & (s["hit_ok"] | s["ok"])
+        retired_ks.append(r)
+        rh_ks.append(s["rd_hit"] & r)
+        wh_ks.append(s["wr_hit"] & r)
+        wmask = (s["wr_hit"] & r)[:, None] & s["onehot"]
+        cv_c = jnp.where(wmask, s["val"][:, None], cv_c)
+        cs_c = jnp.where(wmask, MOD, cs_c)
+        fs = sum(jnp.where(sel[j][k], fill_state[:, j], 0)
+                 for j in range(K))
+        fv = sum(jnp.where(sel[j][k], fill_val[:, j], 0)
+                 for j in range(K))
+        fmask = (s["ok"] & r)[:, None] & s["onehot"]
+        ca_c = jnp.where(fmask, s["addr"][:, None], ca_c)
+        cv_c = jnp.where(fmask, fv[:, None], cv_c)
+        cs_c = jnp.where(fmask, fs[:, None], cs_c)
+
+    # ---- per-line fan-out application (same mechanism as single) ---------
+    line_e = jnp.clip(ca_c, 0, E - 1)                             # [N, C]
+    line_dm = dm[line_e]                                          # [N, C, 7]
+    fresh = (line_dm[..., DM_ACT] >> 2) == st.round
+    a_code = jnp.where(fresh, line_dm[..., DM_ACT] & 3, ACT_NONE)
+    a_req = line_dm[..., DM_REQ]
+    valid = cs_c != INV
+    not_self = a_req != rows[:, None]
+    kill = valid & not_self & (a_code == ACT_KILL)
+    down = valid & not_self & (a_code == ACT_DOWNGRADE)
+    promo = valid & not_self & (a_code == ACT_PROMOTE)
+    cs_c = jnp.where(kill, INV,
+                     jnp.where(down, SHD, jnp.where(promo, EXC, cs_c)))
+    dm = dm.at[jnp.where(promo, line_e, E).reshape(-1), DM_OWNER].set(
+        jnp.broadcast_to(rows[:, None], (N, C)).reshape(-1), mode="drop")
+
+    # ---- bookkeeping -----------------------------------------------------
+    retired_k = jnp.stack(retired_ks, axis=1)                     # [N, W]
+    n_retired = jnp.sum(retired_k, axis=1, dtype=jnp.int32)
+    deltas = jnp.sum(jnp.stack([
+        n_retired,
+        jnp.sum(jnp.stack(rh_ks, axis=1), axis=1, dtype=jnp.int32),
+        jnp.sum(jnp.stack(wh_ks, axis=1), axis=1, dtype=jnp.int32),
+        jnp.sum(rd_w, axis=1, dtype=jnp.int32),
+        jnp.sum(wr_w, axis=1, dtype=jnp.int32),
+        jnp.sum(up_w, axis=1, dtype=jnp.int32),
+        # conflicts = claim-arbitration losses only (matching the single
+        # path's `txn & ~win`), not slots truncated by an earlier loss
+        # or a failed dependent/interior hit
+        jnp.sum(exists & ~win, axis=1, dtype=jnp.int32),
+        jnp.sum(ev, axis=1, dtype=jnp.int32),
+        jnp.sum(kill, axis=1, dtype=jnp.int32),
+        jnp.sum(promo, axis=1, dtype=jnp.int32),
+    ]), axis=1)                                                   # [10]
+    mt = st.metrics
+    metrics = mt.replace(
+        rounds=mt.rounds + 1,
+        instrs_retired=mt.instrs_retired + deltas[0],
+        read_hits=mt.read_hits + deltas[1],
+        write_hits=mt.write_hits + deltas[2],
+        read_misses=mt.read_misses + deltas[3],
+        write_misses=mt.write_misses + deltas[4],
+        upgrades=mt.upgrades + deltas[5],
+        conflicts=mt.conflicts + deltas[6],
+        evictions=mt.evictions + deltas[7],
+        invalidations=mt.invalidations + deltas[8],
+        promotions=mt.promotions + deltas[9],
+    )
+    new_st = st.replace(cache_addr=ca_c, cache_val=cv_c, cache_state=cs_c,
+                        dm=dm, idx=idx0 + n_retired, round=st.round + 1,
+                        metrics=metrics)
+    if not with_events:
+        return new_st
+    events = {"retired": retired_k, "op": w_op, "addr": w_addr,
               "value": w_val}
     return new_st, events
 
