@@ -31,9 +31,9 @@ def main():
                          "enough to measure sustained throughput (the "
                          "device link adds ~0.1 s fixed dispatch cost "
                          "per run, PERF.md)")
-    ap.add_argument("--chunk", type=int, default=32,
+    ap.add_argument("--chunk", type=int, default=64,
                     help="cycles/rounds per quiescence-check chunk "
-                         "(32 measured best on the attached device)")
+                         "(64 measured best on the attached device)")
     ap.add_argument("--workload", default="uniform")
     ap.add_argument("--local-frac", type=float, default=0.8)
     ap.add_argument("--drain-depth", type=int, default=None,

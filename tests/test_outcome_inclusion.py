@@ -42,17 +42,17 @@ def fingerprint_sync(cfg, st):
             + bv.tobytes())
 
 
-def async_outcomes(cfg, traces, max_delay=6):
+def async_outcomes(cfg, traces, max_delay=6, delay_step=2, n_ranks=4):
     """Final-state set over issue-delay tuples x arbitration ranks."""
     out = {}
     active = [n for n, tr in enumerate(traces) if tr]
-    ranks = list(itertools.permutations(range(cfg.num_nodes)))[:8]
-    for delays in itertools.product(range(0, max_delay, 2),
+    ranks = list(itertools.permutations(range(cfg.num_nodes)))
+    for delays in itertools.product(range(0, max_delay, delay_step),
                                     repeat=len(active)):
         d = np.zeros(cfg.num_nodes, np.int32)
         for n, dv in zip(active, delays):
             d[n] = dv
-        for rank in ranks[:4]:
+        for rank in ranks[:n_ranks]:
             st = init_state(cfg, traces, issue_delay=d,
                             arb_rank=np.asarray(rank, np.int32))
             st = run_to_quiescence(cfg, st, 10_000)
@@ -99,4 +99,45 @@ def test_sync_outcomes_are_reachable_async_outcomes(name):
     assert not missing, (
         f"{name}: sync seeds {sorted(missing.values())} produced final "
         f"states outside the async outcome set "
+        f"({len(s)} sync / {len(a)} async outcomes)")
+
+
+# Window-composition races: per-node sequences long enough that a
+# txn_width>1 window exercises release (same-slot displacement of an
+# own fill), reacquire (evict-then-miss on one entry), and dependent
+# write hits (write on an own read fill) *under contention* from a
+# second node. 0x20/0x24 share a cache slot (blocks 0 and 4, C=4).
+WINDOW_CASES = {
+    "window_release": [
+        [(1, 0x20, 11), (1, 0x24, 12)],          # fill then displace
+        [(1, 0x20, 99)], [], []],
+    "window_reacquire": [
+        [(1, 0x20, 1), (1, 0x24, 2), (0, 0x20, 0)],  # evict, reacquire
+        [(1, 0x20, 9)], [], []],
+    "window_dep_hit": [
+        [(0, 0x20, 0), (1, 0x20, 5)],            # rd fill then write
+        [(0, 0x20, 0), (1, 0x24, 7)], [], []],
+    "window_chain_race": [
+        [(1, 0x20, 1), (1, 0x24, 2), (0, 0x20, 0), (1, 0x20, 3)],
+        [(0, 0x24, 0), (1, 0x20, 8)], [], []],
+}
+
+
+@pytest.mark.parametrize("name", sorted({**CASES, **WINDOW_CASES}))
+def test_multi_txn_window_outcomes_are_reachable(name):
+    """txn_width=4 windows (release/reacquire/dependent-hit composition)
+    must still land only in the message-level machine's outcome set."""
+    traces = {**CASES, **WINDOW_CASES}[name]
+    # longer per-node sequences reach more interleavings than the short
+    # CASES — enumerate the async schedule space densely (all single
+    # delays, every arbitration permutation) or inclusion misreports
+    a = async_outcomes(SystemConfig.reference(), traces, max_delay=8,
+                       delay_step=1, n_ranks=24)
+    cfg = SystemConfig.reference(txn_width=4)
+    s = sync_outcomes(cfg, traces)
+    assert len(a) >= 1 and len(s) >= 1
+    missing = {fp: seed for fp, seed in s.items() if fp not in a}
+    assert not missing, (
+        f"{name}: txn_width=4 seeds {sorted(missing.values())} produced "
+        f"final states outside the async outcome set "
         f"({len(s)} sync / {len(a)} async outcomes)")
