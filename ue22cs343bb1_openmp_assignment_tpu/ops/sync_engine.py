@@ -889,8 +889,6 @@ def _round_step_multi(cfg: SystemConfig, st: SyncState,
     thresh = (jnp.maximum(claim_max_rounds(cfg) - st.round, 0) + 1) \
         << prio_bits
     hgot = hrow[..., DM_CLAIM]                                    # [N, W]
-    hc_k = jnp.stack([steps[k]["hc"] for k in range(W)], axis=1)
-    h_unsafe = hc_k & ~((hgot >= thresh) | (hgot == keyK))
 
     # ---- effective primary rows (before commit: truncation needs d_u) ----
     d1s, d1c, d1o, d1m = (d1[..., DM_STATE], d1[..., DM_COUNT],
@@ -917,15 +915,20 @@ def _round_step_multi(cfg: SystemConfig, st: SyncState,
 
     # tentative writes on own read fills retire iff the fill resolved
     # EXCLUSIVE (entry Uncached at acquire) — a silent E->M write hit;
-    # a SHARED resolution would need an upgrade, so it truncates
-    dep_k = jnp.stack([steps[k]["dep"] for k in range(W)], axis=1)
-    dep_ok = jnp.zeros((N, W), bool)
-    for j in range(K):
-        dep_ok |= (dep_k == j) & d_u[:, j:j + 1]
-    w_iota = jnp.arange(W, dtype=jnp.int32)[None, :]
-    first_bad_hit = jnp.min(
-        jnp.where(h_unsafe | ((dep_k < K) & ~dep_ok), w_iota, W),
-        axis=1)                                                   # [N]
+    # a SHARED resolution would need an upgrade, so it truncates.
+    # Running min over per-step slices — no [N, W] stacks (each stack
+    # materializes W buffers = kernels on the bench device)
+    first_bad_hit = jnp.full((N,), W, jnp.int32)
+    for k in range(W):
+        dep = steps[k]["dep"]
+        dok = jnp.zeros((N,), bool)
+        for j in range(K):
+            dok |= (dep == j) & d_u[:, j]
+        hg = hgot[:, k]
+        unsafe = (steps[k]["hc"] & ~((hg >= thresh) | (hg == key))) \
+            | ((dep < K) & ~dok)
+        first_bad_hit = jnp.minimum(first_bad_hit,
+                                    jnp.where(unsafe, k, W))
     # committed = the leading prefix of transactions that win their
     # claims and sit before any unsafe interior hit; the first loss (or
     # unsafe hit) truncates retirement at its window position
@@ -1015,13 +1018,22 @@ def _round_step_multi(cfg: SystemConfig, st: SyncState,
     fill_state = jnp.where(rd_s, jnp.where(d_u, EXC, SHD), MOD)   # [N, K]
     fill_val = jnp.where(rd_s, jnp.where(d_em, val_o, d1m), val_s)
     ca_c, cv_c, cs_c = st.cache_addr, st.cache_val, st.cache_state
-    retired_ks, rh_ks, wh_ks = [], [], []
+    # running [N] accumulators fuse into the replay; stacking per-step
+    # arrays materialized W extra buffers per counter (copies are
+    # kernels on the bench device). The [N, W] record is built only on
+    # the events path.
+    retired_ks = []
+    n_retired = jnp.zeros((N,), jnp.int32)
+    rh_n = jnp.zeros((N,), jnp.int32)
+    wh_n = jnp.zeros((N,), jnp.int32)
     for k in range(W):
         s = steps[k]
         r = (k < first_lose) & (s["hit_ok"] | s["ok"])
-        retired_ks.append(r)
-        rh_ks.append(s["rd_hit"] & r)
-        wh_ks.append(s["wr_hit"] & r)
+        if with_events:
+            retired_ks.append(r)
+        n_retired = n_retired + r
+        rh_n = rh_n + (s["rd_hit"] & r)
+        wh_n = wh_n + (s["wr_hit"] & r)
         wmask = (s["wr_hit"] & r)[:, None] & s["onehot"]
         cv_c = jnp.where(wmask, s["val"][:, None], cv_c)
         cs_c = jnp.where(wmask, MOD, cs_c)
@@ -1051,12 +1063,10 @@ def _round_step_multi(cfg: SystemConfig, st: SyncState,
         jnp.broadcast_to(rows[:, None], (N, C)).reshape(-1), mode="drop")
 
     # ---- bookkeeping -----------------------------------------------------
-    retired_k = jnp.stack(retired_ks, axis=1)                     # [N, W]
-    n_retired = jnp.sum(retired_k, axis=1, dtype=jnp.int32)
     deltas = jnp.sum(jnp.stack([
         n_retired,
-        jnp.sum(jnp.stack(rh_ks, axis=1), axis=1, dtype=jnp.int32),
-        jnp.sum(jnp.stack(wh_ks, axis=1), axis=1, dtype=jnp.int32),
+        rh_n,
+        wh_n,
         jnp.sum(rd_w, axis=1, dtype=jnp.int32),
         jnp.sum(wr_w, axis=1, dtype=jnp.int32),
         jnp.sum(up_w, axis=1, dtype=jnp.int32),
@@ -1087,7 +1097,8 @@ def _round_step_multi(cfg: SystemConfig, st: SyncState,
                         metrics=metrics)
     if not with_events:
         return new_st
-    events = {"retired": retired_k, "op": w_op, "addr": w_addr,
+    events = {"retired": jnp.stack(retired_ks, axis=1), "op": w_op,
+              "addr": w_addr,
               "value": w_val}
     return new_st, events
 
