@@ -122,3 +122,24 @@ def test_sync_engine_trace_log_program_order(tmp_path):
     path = str(tmp_path / "order.txt")
     eventlog.write_sync_log(path, events)
     assert open(path).read().splitlines() == lines
+
+
+def test_multi_txn_window_trace_log_program_order():
+    """Multi-transaction windows (txn_width>1) must still emit a
+    retirement log whose per-node projection is exact program order."""
+    from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+    from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+    from ue22cs343bb1_openmp_assignment_tpu.state import init_state
+    from ue22cs343bb1_openmp_assignment_tpu.utils.trace import load_test_dir
+
+    ref_dir = os.path.join(REFERENCE_TESTS, "test_1")
+    cfg = SystemConfig.reference(txn_width=3)
+    traces = load_test_dir(ref_dir)
+    st = se.from_sim_state(cfg, init_state(cfg, traces))
+    st, events = se.run_rounds_traced(cfg, st, 64)
+    assert bool(st.quiescent())
+    lines = [eventlog.format_record(r)
+             for r in eventlog.sync_to_records(events)]
+    golden = open(f"{ref_dir}/instruction_order.txt").read().splitlines()
+    assert (eventlog.per_node_projection(lines)
+            == eventlog.per_node_projection(golden))
