@@ -63,6 +63,13 @@ def main():
                          "arbitrarily long). Bit-exact-equivalent to the "
                          "materialized stream (tests/test_procedural.py); "
                          "--no-procedural gathers a stored trace instead")
+    ap.add_argument("--pallas", default=None,
+                    action=argparse.BooleanOptionalAction,
+                    help="sync engine, procedural: run the window fold "
+                         "as fused Pallas kernels (ops.pallas_window). "
+                         "Default: on when a TPU backend is attached "
+                         "(+19%% measured); off elsewhere (the CPU "
+                         "interpreter is impractically slow)")
     ap.add_argument("--profile", metavar="DIR",
                     help="capture a jax.profiler trace of one timed run "
                          "into DIR (viewable with TensorBoard/Perfetto; "
@@ -111,6 +118,24 @@ def main():
         cfg = dataclasses.replace(
             cfg, procedural="uniform", max_instrs=1,
             proc_local_permille=int(args.local_frac * 1000))
+        # the kernels tile the node axis at 1024 (ops.pallas_burst._tile)
+        tileable = args.nodes <= 1024 or args.nodes % 1024 == 0
+        on_tpu = jax.default_backend() == "tpu"
+        if args.pallas is None:
+            args.pallas = on_tpu and tileable
+        elif args.pallas and not (tileable and on_tpu):
+            why = ("a TPU backend (the CPU interpreter takes minutes "
+                   "per kernel call)" if not on_tpu else
+                   "--nodes <= 1024 or a multiple of 1024")
+            print(f"note: --pallas needs {why}; measuring the XLA "
+                  "path instead", file=sys.stderr)
+            args.pallas = False
+        if args.pallas:
+            cfg = dataclasses.replace(cfg, pallas_burst=True)
+    elif args.pallas:
+        print("note: --pallas applies only to the sync engine's "
+              "procedural path; measuring without the Pallas kernels",
+              file=sys.stderr)
     gen_kw = {"local_frac": args.local_frac} if args.workload == "uniform" else {}
 
     def make_system(seed):

@@ -25,7 +25,9 @@ print("async engine quiescent:", sys_.quiescent)
 print(sys_.dumps()[0][:160], "...\n")   # printProcessorState, byte-exact
 
 # -- 2. transactional engine: atomic rounds at scale ---------------------
-big = SystemConfig.scale(num_nodes=1024, drain_depth=16)
+# txn_width=3: each node may commit up to 3 coherence transactions per
+# round (multi-transaction windows — the throughput default in bench.py)
+big = SystemConfig.scale(num_nodes=1024, drain_depth=4, txn_width=3)
 tsys = TransactionalSystem.from_workload(
     big, "uniform", trace_len=64, local_frac=0.8).run()
 print("sync engine:", tsys.metrics["instrs_retired"], "instrs,",

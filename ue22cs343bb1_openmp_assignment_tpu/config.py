@@ -81,6 +81,18 @@ class SystemConfig:
     proc_write_permille: int = 500
     proc_seed: int = 0
 
+    # Execute the round's node-local phase as fused Pallas TPU kernels
+    # instead of XLA fusions: the burst phase of single-transaction
+    # rounds (ops.pallas_burst) or the window fold + replay of
+    # multi-transaction rounds (ops.pallas_window). Requires a
+    # procedural workload (stored-trace windows need a dynamic gather
+    # TPU Pallas cannot vectorize). Measured on the attached TPU:
+    # +24% on the single path, +19% at txn_width=3 (PERF.md). OFF by
+    # default because the CPU fallback is the Pallas interpreter,
+    # which is impractically slow at full kernel size — bench.py turns
+    # it on automatically when a TPU backend is attached.
+    pallas_burst: bool = False
+
     # Admission window (backpressure): maximum number of simultaneously
     # outstanding request transactions system-wide. The reference silently
     # drops on overflow (assignment.c:754-762), which at its dimensions is

@@ -1,0 +1,515 @@
+"""Pallas TPU kernels for the multi-transaction window round.
+
+`_round_step_multi`'s window fold — the sequential W-step
+classification that sizes each node's transaction window — is
+node-local, gather-free under a procedural workload, and the
+fusion-fragmented part of the round (~74 XLA fusions plus dozens of
+small stacking copies at K=3, PERF.md). Here it runs as TWO fused
+kernels around the unavoidable claim scatter / row gather:
+
+* **window kernel** (pre-claim): runs the fold, emits the per-slot
+  transaction records ([K, tile] rows), the per-step hit-probe /
+  dependent-write records ([W, tile]), and the prefix cache.
+* **replay kernel** (post-claim): re-runs the same fold (same helper,
+  bit-identical classification) and applies the retired prefix —
+  truncation point and resolved fill values now known — producing the
+  committed cache and the retirement counters.
+
+Between them the claim scatter-min, the one row gather, win/truncation
+resolution, transaction outcomes, and the commit scatter stay in XLA
+(they are gathers/scatters either way), computed in the kernels'
+transposed [K, N] layout so no per-field transposes appear.
+
+The fold helper mirrors `_round_step_multi`'s fold line for line with
+cache state as per-line [1, T] rows (the lane axis is the node tile):
+`tests/test_pallas_window.py` pins full rounds bit-identical to the
+XLA path. Enabled by `cfg.pallas_burst` (procedural workloads,
+`txn_width > 1`, no event tracing).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ue22cs343bb1_openmp_assignment_tpu import codec
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.procedural import procedural_instr
+from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState, Op
+
+from ue22cs343bb1_openmp_assignment_tpu.ops.sync_engine import (
+    ACT_DOWNGRADE, ACT_KILL, ACT_NONE, ACT_PROMOTE, DM_ACT, DM_CLAIM,
+    DM_COLS, DM_COUNT, DM_MEM, DM_OWNER, DM_REQ, DM_STATE, SyncState,
+    _round_key, claim_max_rounds)
+
+
+def _fold(cfg: SystemConfig, T: int, node, idx, cnt, ca, cv, cs):
+    """The window fold on [1, T] rows; returns (steps, cv_pre_rows).
+
+    `ca`/`cv`/`cs` are lists of C per-line [1, T] rows. Mirrors the
+    fold in `_round_step_multi` exactly (same helpers, same formula
+    order) so both paths classify bit-identically.
+    """
+    C, K = cfg.cache_size, cfg.txn_width
+    W = cfg.drain_depth + K
+    E = cfg.num_nodes << cfg.block_bits
+    INV = int(CacheState.INVALID)
+    MOD = int(CacheState.MODIFIED)
+    EXC = int(CacheState.EXCLUSIVE)
+    SHD = int(CacheState.SHARED)
+    one = jnp.ones_like(idx)
+
+    ca_f, cv_f, cs_f = list(ca), list(cv), list(cs)
+    fo_f = [one * K for _ in range(C)]
+    cv_pre = list(cv_f)
+    frozen = jnp.zeros_like(idx, bool)
+    stopped = jnp.zeros_like(idx, bool)
+    n_txn = jnp.zeros_like(idx)
+    fills, victs, steps = [], [], []
+    for k in range(W):
+        w_idx = idx + k
+        live = w_idx < cnt
+        oa, val = procedural_instr(cfg, node, w_idx)
+        op, addr = oa >> 28, oa & 0x0FFFFFFF
+        ci = codec.cache_index(cfg, addr)
+        l_addr, l_val, l_state, l_fo = ca_f[0], cv_f[0], cs_f[0], fo_f[0]
+        for c in range(1, C):
+            m = ci == c
+            l_addr = jnp.where(m, ca_f[c], l_addr)
+            l_val = jnp.where(m, cv_f[c], l_val)
+            l_state = jnp.where(m, cs_f[c], l_state)
+            l_fo = jnp.where(m, fo_f[c], l_fo)
+        tag_ok = (l_addr == addr) & (l_state != INV)
+        is_rd, is_wr = op == int(Op.READ), op == int(Op.WRITE)
+        rd_hit = live & is_rd & tag_ok
+        wr_hit = live & is_wr & tag_ok & ((l_state == MOD)
+                                          | (l_state == EXC))
+        wr_dep = live & is_wr & tag_ok & (l_state == SHD) & (l_fo < K)
+        hit = rd_hit | wr_hit | wr_dep | (live & (op == int(Op.NOP)))
+        upg = live & is_wr & tag_ok & (l_state == SHD) & (l_fo == K)
+        rd_miss = live & is_rd & ~tag_ok
+        wr_miss = live & is_wr & ~tag_ok
+        e1 = jnp.clip(addr, 0, E - 1)
+        has_victim = ~tag_ok & (l_state != INV) & (l_addr != addr)
+        e2 = jnp.clip(l_addr, 0, E - 1)
+        own1 = jnp.zeros_like(idx, bool)
+        dup = jnp.zeros_like(idx, bool)
+        rel_ord = one * K
+        acq_base = one * K
+        for te, tv, tord in fills:
+            own1 |= tv & (te == e1)
+            dup |= tv & (te == e1)
+            rel_ord = jnp.where(tv & has_victim & (te == e2), tord,
+                                rel_ord)
+        for te, tv, tord, telig in victs:
+            m = tv & (te == e1)
+            dup |= m & ~telig
+            acq_base = jnp.where(m & telig, tord, acq_base)
+        hc = hit & ~stopped & frozen & ~own1
+        hit_ok = (hit & ~stopped & (~frozen | own1)) | hc
+        txn = (rd_miss | wr_miss | upg) & ~stopped
+        ok = txn & ~dup & (n_txn < K)
+        rel_ord = jnp.where(ok, rel_ord, K)
+        acq_base = jnp.where(ok, acq_base, K)
+        stop_now = ~hit_ok & ~ok & ~stopped
+        wlike_f = ok & (wr_miss | upg)
+        reacq_rd = ok & rd_miss & (acq_base == K)
+        for c in range(C):
+            mc = ci == c
+            wm = ((wr_hit | wr_dep) & hit_ok) & mc
+            cv_f[c] = jnp.where(wm, val, cv_f[c])
+            cs_f[c] = jnp.where(wm, MOD, cs_f[c])
+            cv_pre[c] = jnp.where(frozen, cv_pre[c], cv_f[c])
+        frozen = frozen | ok
+        for c in range(C):
+            mc = ci == c
+            fm = ok & mc
+            ca_f[c] = jnp.where(fm, addr, ca_f[c])
+            cv_f[c] = jnp.where(wlike_f & mc, val, cv_f[c])
+            cs_f[c] = jnp.where(
+                fm, jnp.where(wlike_f, MOD,
+                              jnp.where(acq_base < K, EXC, SHD)),
+                cs_f[c])
+            fo_f[c] = jnp.where(fm, jnp.where(reacq_rd, n_txn, K),
+                                fo_f[c])
+        steps.append(dict(
+            hit_ok=hit_ok, rd_hit=rd_hit & hit_ok,
+            wr_hit=(wr_hit | wr_dep) & hit_ok,
+            dep=jnp.where(wr_dep & hit_ok, l_fo, K),
+            ok=ok, ordn=jnp.where(ok, n_txn, K), addr=addr, val=val,
+            ci=ci, e1=e1, e2=e2, victim=ok & has_victim,
+            rd=ok & rd_miss, wr=ok & wr_miss, up=ok & upg, v_val=l_val,
+            v_mod=l_state == MOD, rel_ordn=rel_ord, acq_basen=acq_base,
+            hc=hc))
+        fills.append((e1, ok, n_txn))
+        victs.append((e2, ok & has_victim, n_txn,
+                      ((l_state == MOD) | (l_state == EXC))
+                      & (rel_ord == K)))
+        n_txn = n_txn + ok
+        stopped = stopped | stop_now
+    return steps, cv_pre
+
+
+_SLOT_FIELDS = ("ok", "e1", "e2", "val", "v_val", "victim", "rd", "wr",
+                "up", "v_mod", "rel_ordn", "acq_basen")
+_STEP_FIELDS = ("hc", "dep", "e1")
+
+
+def _window_kernel(cfg, T, ca_ref, cv_ref, cs_ref, idx_ref, cnt_ref,
+                   *out_refs):
+    C, K = cfg.cache_size, cfg.txn_width
+    W = cfg.drain_depth + K
+    pid = pl.program_id(0)
+    node = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1) + pid * T
+    ca = [ca_ref[c:c + 1, :] for c in range(C)]
+    cv = [cv_ref[c:c + 1, :] for c in range(C)]
+    cs = [cs_ref[c:c + 1, :] for c in range(C)]
+    steps, cv_pre = _fold(cfg, T, node, idx_ref[...], cnt_ref[...],
+                          ca, cv, cs)
+    # pack by ordinal: slot j's record comes from the step whose
+    # transaction ordinal is j
+    sel = [[steps[k]["ordn"] == j for k in range(W)] for j in range(K)]
+
+    def slot_rows(name):
+        rows = []
+        for j in range(K):
+            acc = jnp.zeros((1, T), jnp.int32)
+            for k in range(W):
+                acc = jnp.where(sel[j][k],
+                                steps[k][name].astype(jnp.int32), acc)
+            rows.append(acc)
+        return jnp.concatenate(rows, axis=0)                  # [K, T]
+
+    outs = [slot_rows(f) for f in _SLOT_FIELDS]
+    pos_rows = []
+    for j in range(K):
+        acc = jnp.zeros((1, T), jnp.int32)
+        for k in range(W):
+            acc = jnp.where(sel[j][k], k, acc)
+        pos_rows.append(acc)
+    outs.append(jnp.concatenate(pos_rows, axis=0))            # pos [K, T]
+    for f in _STEP_FIELDS:
+        outs.append(jnp.concatenate(
+            [steps[k][f].astype(jnp.int32) for k in range(W)], axis=0))
+    outs.append(jnp.concatenate(cv_pre, axis=0))              # [C, T]
+    for ref, value in zip(out_refs, outs):
+        ref[...] = value
+
+
+def _replay_kernel(cfg, T, ca_ref, cv_ref, cs_ref, idx_ref, cnt_ref,
+                   fl_ref, fs_ref, fv_ref,
+                   cao_ref, cvo_ref, cso_ref, nr_ref, rh_ref, wh_ref):
+    C, K = cfg.cache_size, cfg.txn_width
+    W = cfg.drain_depth + K
+    MOD = int(CacheState.MODIFIED)
+    pid = pl.program_id(0)
+    node = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1) + pid * T
+    ca0 = [ca_ref[c:c + 1, :] for c in range(C)]
+    cv0 = [cv_ref[c:c + 1, :] for c in range(C)]
+    cs0 = [cs_ref[c:c + 1, :] for c in range(C)]
+    steps, _ = _fold(cfg, T, node, idx_ref[...], cnt_ref[...],
+                     ca0, cv0, cs0)
+    first_lose = fl_ref[...]
+    ca_c, cv_c, cs_c = list(ca0), list(cv0), list(cs0)
+    zero = jnp.zeros((1, T), jnp.int32)
+    n_ret, rh, wh = zero, zero, zero
+    for k in range(W):
+        s = steps[k]
+        r = (k < first_lose) & (s["hit_ok"] | s["ok"])
+        n_ret = n_ret + r
+        rh = rh + (s["rd_hit"] & r)
+        wh = wh + (s["wr_hit"] & r)
+        fs, fv = zero, zero
+        for j in range(K):
+            sj = s["ordn"] == j
+            fs = jnp.where(sj, fs_ref[j:j + 1, :], fs)
+            fv = jnp.where(sj, fv_ref[j:j + 1, :], fv)
+        for c in range(C):
+            mc = s["ci"] == c
+            wm = (s["wr_hit"] & r) & mc
+            cv_c[c] = jnp.where(wm, s["val"], cv_c[c])
+            cs_c[c] = jnp.where(wm, MOD, cs_c[c])
+            fm = (s["ok"] & r) & mc
+            ca_c[c] = jnp.where(fm, s["addr"], ca_c[c])
+            cv_c[c] = jnp.where(fm, fv, cv_c[c])
+            cs_c[c] = jnp.where(fm, fs, cs_c[c])
+    cao_ref[...] = jnp.concatenate(ca_c, axis=0)
+    cvo_ref[...] = jnp.concatenate(cv_c, axis=0)
+    cso_ref[...] = jnp.concatenate(cs_c, axis=0)
+    nr_ref[...] = n_ret
+    rh_ref[...] = rh
+    wh_ref[...] = wh
+
+
+from ue22cs343bb1_openmp_assignment_tpu.ops.pallas_burst import (
+    _interpret, _tile)
+
+
+def _call_window(cfg, ca_t, cv_t, cs_t, idx2, cnt2):
+    C, K = cfg.cache_size, cfg.txn_width
+    W = cfg.drain_depth + K
+    N = cfg.num_nodes
+    T = _tile(N)
+    vec = pl.BlockSpec((1, T), lambda i: (0, i))
+    matC = pl.BlockSpec((C, T), lambda i: (0, i))
+    matK = pl.BlockSpec((K, T), lambda i: (0, i))
+    matW = pl.BlockSpec((W, T), lambda i: (0, i))
+    sK = jax.ShapeDtypeStruct((K, N), jnp.int32)
+    sW = jax.ShapeDtypeStruct((W, N), jnp.int32)
+    sC = jax.ShapeDtypeStruct((C, N), jnp.int32)
+    n_slot = len(_SLOT_FIELDS) + 1          # + pos
+    n_step = len(_STEP_FIELDS)
+    return pl.pallas_call(
+        functools.partial(_window_kernel, cfg, T),
+        grid=(N // T,),
+        in_specs=[matC] * 3 + [vec] * 2,
+        out_specs=[matK] * n_slot + [matW] * n_step + [matC],
+        out_shape=[sK] * n_slot + [sW] * n_step + [sC],
+        interpret=_interpret(),
+    )(ca_t, cv_t, cs_t, idx2, cnt2)
+
+
+def _call_replay(cfg, ca_t, cv_t, cs_t, idx2, cnt2, first_lose,
+                 fill_state, fill_val):
+    C, K = cfg.cache_size, cfg.txn_width
+    N = cfg.num_nodes
+    T = _tile(N)
+    vec = pl.BlockSpec((1, T), lambda i: (0, i))
+    matC = pl.BlockSpec((C, T), lambda i: (0, i))
+    matK = pl.BlockSpec((K, T), lambda i: (0, i))
+    sV = jax.ShapeDtypeStruct((1, N), jnp.int32)
+    sC = jax.ShapeDtypeStruct((C, N), jnp.int32)
+    return pl.pallas_call(
+        functools.partial(_replay_kernel, cfg, T),
+        grid=(N // T,),
+        in_specs=[matC] * 3 + [vec] * 2 + [vec] + [matK] * 2,
+        out_specs=[matC] * 3 + [vec] * 3,
+        out_shape=[sC] * 3 + [sV] * 3,
+        interpret=_interpret(),
+    )(ca_t, cv_t, cs_t, idx2, cnt2, first_lose, fill_state, fill_val)
+
+
+def round_step_multi_pallas(cfg: SystemConfig, st: SyncState) -> SyncState:
+    """One multi-transaction round with the fold in Pallas kernels.
+
+    Bit-identical to `_round_step_multi` (tests/test_pallas_window.py);
+    requires cfg.procedural and txn_width > 1, no event tracing.
+    """
+    N, C = cfg.num_nodes, cfg.cache_size
+    K = cfg.txn_width
+    E = N << cfg.block_bits
+    INV = int(CacheState.INVALID)
+    MOD = int(CacheState.MODIFIED)
+    EXC = int(CacheState.EXCLUSIVE)
+    SHD = int(CacheState.SHARED)
+    rows0 = jnp.arange(N, dtype=jnp.int32)                   # [N]
+
+    ca_t = st.cache_addr.T
+    cv_t = st.cache_val.T
+    cs_t = st.cache_state.T
+    idx2 = st.idx[None, :]
+    cnt2 = st.instr_count[None, :]
+
+    outs = _call_window(cfg, ca_t, cv_t, cs_t, idx2, cnt2)
+    n_slot = len(_SLOT_FIELDS) + 1
+    slot = dict(zip(_SLOT_FIELDS + ("pos",), outs[:n_slot]))
+    hc_w, dep_w, he_w = outs[n_slot:n_slot + 3]
+    cv_pre = outs[-1]                                        # [C, N]
+
+    exists = slot["ok"].astype(bool)                         # [K, N]
+    e1_s, e2_s = slot["e1"], slot["e2"]
+    val_s, v_val_s = slot["val"], slot["v_val"]
+    victim_s = slot["victim"].astype(bool)
+    rd_s, wr_s, up_s = (slot["rd"].astype(bool), slot["wr"].astype(bool),
+                        slot["up"].astype(bool))
+    v_mod_s = slot["v_mod"].astype(bool) & victim_s
+    rel_s = jnp.where(exists, slot["rel_ordn"], K)
+    acqb_s = jnp.where(exists, slot["acq_basen"], K)
+    pos_s = slot["pos"]
+
+    # ---- claim + one row gather (XLA; transposed layout) -----------------
+    key = _round_key(cfg, st, rows0)                         # [N]
+    c_idx = jnp.concatenate(
+        [jnp.where(exists[j], e1_s[j], E) for j in range(K)]
+        + [jnp.where(victim_s[j], e2_s[j], E) for j in range(K)])
+    dm_claimed = st.dm.at[c_idx, DM_CLAIM].min(jnp.tile(key, 2 * K),
+                                               mode="drop")
+    W = cfg.drain_depth + K
+    g = dm_claimed[jnp.concatenate(
+        [e1_s, e2_s, he_w], axis=0).reshape(-1)].reshape(2 * K + W, N,
+                                                         DM_COLS)
+    d1, d2, hrow = g[:K], g[K:2 * K], g[2 * K:]
+    key1 = key[None, :]
+    win = exists & (d1[..., DM_CLAIM] == key1) & (
+        ~victim_s | (d2[..., DM_CLAIM] == key1))
+
+    # ---- effective primary rows (reacquire chains) -----------------------
+    d1s, d1c, d1o, d1m = (d1[..., DM_STATE], d1[..., DM_COUNT],
+                          d1[..., DM_OWNER], d1[..., DM_MEM])
+    d2c, d2o, d2m = d2[..., DM_COUNT], d2[..., DM_OWNER], d2[..., DM_MEM]
+    pe_m = jnp.where(v_mod_s, v_val_s, d2m)                  # [K, N]
+    base_u = jnp.zeros((K, N), bool)
+    base_m = jnp.zeros((K, N), jnp.int32)
+    for i in range(K):
+        m = acqb_s == i
+        base_u |= m
+        base_m = jnp.where(m, pe_m[i:i + 1], base_m)
+    d1s = jnp.where(base_u, int(DirState.U), d1s)
+    d1c = jnp.where(base_u, 0, d1c)
+    d1m = jnp.where(base_u, base_m, d1m)
+    d_u = d1s == int(DirState.U)
+    d_em = d1s == int(DirState.EM)
+
+    # ---- truncation: losses + unsafe interior/dependent hits -------------
+    prio_bits = max(1, (N - 1).bit_length())
+    thresh = (jnp.maximum(claim_max_rounds(cfg) - st.round, 0) + 1) \
+        << prio_bits
+    hgot = hrow[..., DM_CLAIM]                               # [W, N]
+    first_bad_hit = jnp.full((N,), W, jnp.int32)
+    for k in range(W):
+        dep = dep_w[k]
+        dok = jnp.zeros((N,), bool)
+        for j in range(K):
+            dok |= (dep == j) & d_u[j]
+        unsafe = ((hc_w[k].astype(bool)
+                   & ~((hgot[k] >= thresh) | (hgot[k] == key)))
+                  | ((dep < K) & ~dok))
+        first_bad_hit = jnp.minimum(first_bad_hit,
+                                    jnp.where(unsafe, k, W))
+    eligible = win & (pos_s < first_bad_hit[None, :])
+    cum = []
+    run = jnp.ones((N,), bool)
+    for j in range(K):
+        run = run & (eligible[j] | ~exists[j])
+        cum.append(run)
+    cum = jnp.stack(cum, axis=0)                             # [K, N]
+    commit = exists & cum
+    first_lose = jnp.minimum(
+        jnp.min(jnp.where(exists & ~cum, pos_s, W), axis=0),
+        first_bad_hit)                                       # [N]
+
+    # ---- transaction outcomes --------------------------------------------
+    rd_w, wr_w, up_w = commit & rd_s, commit & wr_s, commit & up_s
+    wlike = wr_w | up_w
+    ci_s = codec.cache_index(cfg, e1_s)
+    safe_o = jnp.clip(d1o, 0, N - 1)
+    # cv_pre is [C, N]: owner o's line ci lives at flat ci * N + o
+    val_o = cv_pre.reshape(-1)[ci_s * N + safe_o]            # [K, N]
+    n1s = jnp.where(wlike | (rd_w & d_u), int(DirState.EM),
+                    int(DirState.S))
+    n1c = jnp.where(wlike | (rd_w & d_u), 1,
+                    jnp.where(rd_w & d_em, 2, d1c + 1))
+    n1o = jnp.where(wlike | (rd_w & d_u), rows0[None, :], d1o)
+    n1m = jnp.where((rd_w | wr_w) & d_em, val_o, d1m)
+    act1 = jnp.where(wlike, ACT_KILL,
+                     jnp.where(rd_w & d_em, ACT_DOWNGRADE, ACT_NONE))
+    ev = commit & victim_s
+    ev_mod = ev & v_mod_s
+    ev_sh = ev & ~ev_mod
+    n2c = jnp.where(ev_mod, 0, d2c - 1)
+    n2s = jnp.where(n2c == 0, int(DirState.U),
+                    jnp.where(n2c == 1, int(DirState.EM), int(DirState.S)))
+    n2m = jnp.where(ev_mod, v_val_s, d2m)
+    act2 = jnp.where(ev_sh & (n2c == 1), ACT_PROMOTE, ACT_NONE)
+
+    # ---- release / reacquire composition ---------------------------------
+    released = jnp.zeros((K, N), bool)
+    rel_val = jnp.zeros((K, N), jnp.int32)
+    rel_dirty = jnp.zeros((K, N), bool)
+    consumed = jnp.zeros((K, N), bool)
+    j_iota = jnp.arange(K, dtype=jnp.int32)[:, None]
+    for r in range(K):
+        m = commit[r:r + 1] & (rel_s[r:r + 1] == j_iota)     # [K, N]
+        released |= m
+        rel_val = jnp.where(m, v_val_s[r:r + 1], rel_val)
+        rel_dirty |= m & v_mod_s[r:r + 1]
+        consumed |= commit[r:r + 1] & (acqb_s[r:r + 1] == j_iota)
+    rd_rel_s = released & rd_s & ~d_u & ~d_em
+    r1s = jnp.where(wlike | (rd_s & d_u), int(DirState.U),
+                    jnp.where(rd_s & d_em, int(DirState.EM),
+                              jnp.where(d1c == 1, int(DirState.EM),
+                                        int(DirState.S))))
+    r1c = jnp.where(wlike | (rd_s & d_u), 0,
+                    jnp.where(rd_s & d_em, 1, d1c))
+    r1m = jnp.where(wlike | rel_dirty, rel_val,
+                    jnp.where(rd_s & d_em, val_o, d1m))
+    r1a = jnp.where(wlike, ACT_KILL,
+                    jnp.where((rd_s & d_em) | (rd_rel_s & (d1c == 1)),
+                              ACT_PROMOTE, ACT_NONE))
+    n1s = jnp.where(released, r1s, n1s)
+    n1c = jnp.where(released, r1c, n1c)
+    n1o = jnp.where(released, d1o, n1o)
+    n1m = jnp.where(released, r1m, n1m)
+    act1 = jnp.where(released, r1a, act1)
+    ev_sep = ev & (rel_s == K) & ~consumed
+
+    # ---- commit scatter ---------------------------------------------------
+    rtag = st.round << 2
+    rowsK = jnp.broadcast_to(rows0[None, :], (K, N))
+    keyKb = jnp.broadcast_to(key1, (K, N))
+    t_idx = jnp.concatenate([jnp.where(commit, e1_s, E).reshape(-1),
+                             jnp.where(ev_sep, e2_s, E).reshape(-1)])
+    t_dm = jnp.concatenate([
+        jnp.stack([n1s, n1c, n1o, n1m, rtag | act1, rowsK, keyKb],
+                  axis=-1).reshape(-1, DM_COLS),
+        jnp.stack([n2s, n2c, d2o, n2m, rtag | act2, rowsK, keyKb],
+                  axis=-1).reshape(-1, DM_COLS)])
+    dm = dm_claimed.at[t_idx].set(t_dm, mode="drop")
+
+    # ---- replay kernel ----------------------------------------------------
+    fill_state = jnp.where(rd_s, jnp.where(d_u, EXC, SHD), MOD)
+    fill_val = jnp.where(rd_s, jnp.where(d_em, val_o, d1m), val_s)
+    ca_c, cv_c, cs_c, n_ret2, rh2, wh2 = _call_replay(
+        cfg, ca_t, cv_t, cs_t, idx2, cnt2, first_lose[None, :],
+        fill_state, fill_val)
+    n_retired, rh_n, wh_n = n_ret2[0], rh2[0], wh2[0]
+
+    # ---- fan-out application (transposed [C, N]) --------------------------
+    line_e = jnp.clip(ca_c, 0, E - 1)                        # [C, N]
+    line_dm = dm[line_e]                                     # [C, N, 7]
+    fresh = (line_dm[..., DM_ACT] >> 2) == st.round
+    a_code = jnp.where(fresh, line_dm[..., DM_ACT] & 3, ACT_NONE)
+    a_req = line_dm[..., DM_REQ]
+    valid = cs_c != INV
+    not_self = a_req != rows0[None, :]
+    kill = valid & not_self & (a_code == ACT_KILL)
+    down = valid & not_self & (a_code == ACT_DOWNGRADE)
+    promo = valid & not_self & (a_code == ACT_PROMOTE)
+    cs_c = jnp.where(kill, INV,
+                     jnp.where(down, SHD, jnp.where(promo, EXC, cs_c)))
+    dm = dm.at[jnp.where(promo, line_e, E).reshape(-1), DM_OWNER].set(
+        jnp.broadcast_to(rows0[None, :], (C, N)).reshape(-1),
+        mode="drop")
+
+    # ---- bookkeeping ------------------------------------------------------
+    deltas = jnp.sum(jnp.stack([
+        n_retired, rh_n, wh_n,
+        jnp.sum(rd_w, axis=0, dtype=jnp.int32),
+        jnp.sum(wr_w, axis=0, dtype=jnp.int32),
+        jnp.sum(up_w, axis=0, dtype=jnp.int32),
+        jnp.sum(exists & ~win, axis=0, dtype=jnp.int32),
+        jnp.sum(ev, axis=0, dtype=jnp.int32),
+        jnp.sum(kill, axis=0, dtype=jnp.int32),
+        jnp.sum(promo, axis=0, dtype=jnp.int32),
+    ]), axis=1)                                              # [10]
+    mt = st.metrics
+    metrics = mt.replace(
+        rounds=mt.rounds + 1,
+        instrs_retired=mt.instrs_retired + deltas[0],
+        read_hits=mt.read_hits + deltas[1],
+        write_hits=mt.write_hits + deltas[2],
+        read_misses=mt.read_misses + deltas[3],
+        write_misses=mt.write_misses + deltas[4],
+        upgrades=mt.upgrades + deltas[5],
+        conflicts=mt.conflicts + deltas[6],
+        evictions=mt.evictions + deltas[7],
+        invalidations=mt.invalidations + deltas[8],
+        promotions=mt.promotions + deltas[9],
+    )
+    return st.replace(cache_addr=ca_c.T, cache_val=cv_c.T,
+                      cache_state=cs_c.T, dm=dm,
+                      idx=st.idx + n_retired, round=st.round + 1,
+                      metrics=metrics)

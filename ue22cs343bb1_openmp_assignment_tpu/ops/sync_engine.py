@@ -383,9 +383,15 @@ def round_step(cfg: SystemConfig, st: SyncState,
     per node (`_round_step_single`). txn_width > 1: a window of up to
     txn_width transactions per node commits per round
     (`_round_step_multi`) — same protocol, more progress per device
-    dispatch."""
+    dispatch. cfg.pallas_burst routes the window fold through fused
+    Pallas kernels on procedural workloads (ops.pallas_burst /
+    ops.pallas_window), bit-identically."""
     if cfg.txn_width == 1:
         return _round_step_single(cfg, st, with_events)
+    if cfg.pallas_burst and cfg.procedural and not with_events:
+        from ue22cs343bb1_openmp_assignment_tpu.ops.pallas_window import (
+            round_step_multi_pallas)
+        return round_step_multi_pallas(cfg, st)
     return _round_step_multi(cfg, st, with_events)
 
 
@@ -409,66 +415,78 @@ def _round_step_single(cfg: SystemConfig, st: SyncState,
     ca, cv, cs = st.cache_addr, st.cache_val, st.cache_state
     idx0 = st.idx
 
-    # ---- instruction window: burst of up to H hits + the stopped instr ---
-    # ONE flat gather for the whole window and both fields (idx advances
-    # by at most 1 per burst step, so H+1 lookahead always suffices);
-    # procedural mode computes the window instead — no trace storage
-    offs = jnp.arange(H + 1, dtype=jnp.int32)[None, :]          # [1, H+1]
-    w_idx = idx0[:, None] + offs                                 # [N, H+1]
-    w_live = w_idx < st.instr_count[:, None]
-    if cfg.procedural:
-        w_oa, w_val = procedural_instr(cfg, rows[:, None], w_idx)
-    else:
-        w_flat = rows[:, None] * T + jnp.minimum(w_idx, T - 1)
-        w = st.instr_pack.reshape(N * T, 2)[w_flat]              # [N,H+1,2]
-        w_oa, w_val = w[..., 0], w[..., 1]
-
-    # ---- phase 1: hit burst (node-local, no cross-node effects) ----------
-    # Vectorized over the whole window at once: within a burst only hits
-    # execute, and hits never change any line's tag or hit/miss class
-    # (a write hit needs M/E and leaves M — still a write hit; values
-    # change, classifications don't). So every window position can be
-    # classified against the round-start cache, and the burst length is
-    # the length of the leading all-hit prefix.
-    w_op, w_addr = w_oa >> 28, w_oa & 0x0FFFFFFF                 # [N, H+1]
-    w_ci = codec.cache_index(cfg, w_addr)
     c_iota = jnp.arange(C, dtype=jnp.int32)
-    w_onehot = w_ci[:, :, None] == c_iota[None, None, :]         # [N,H+1,C]
-    pick3 = lambda arr: jnp.sum(
-        jnp.where(w_onehot, arr[:, None, :], 0), axis=2)         # [N, H+1]
-    wl_addr, wl_state = pick3(ca), pick3(cs)
-    w_tagok = (wl_addr == w_addr) & (wl_state != INV)
-    w_rdhit = w_live & (w_op == int(Op.READ)) & w_tagok
-    w_wrhit = w_live & (w_op == int(Op.WRITE)) & w_tagok & (
-        (wl_state == int(CacheState.MODIFIED))
-        | (wl_state == int(CacheState.EXCLUSIVE)))
-    # in-trace NOPs (malformed trace lines, utils.trace) retire with no
-    # effect, like the reference's fall-through on unknown type
-    w_nop = w_live & (w_op == int(Op.NOP))
-    w_hit = w_rdhit | w_wrhit | w_nop
-    # leading all-hit prefix over the first H positions (the H+1-th slot
-    # is only ever the transaction candidate)
-    prefix = jnp.cumprod(w_hit[:, :H].astype(jnp.int32), axis=1)  # [N, H]
-    d = jnp.sum(prefix, axis=1)                                   # [N] <= H
-    in_burst = prefix.astype(bool)                                # [N, H]
-    # burst hit counts per node (summed with the other metrics below in
-    # one stacked reduction — separate jnp.sum calls each cost a kernel
-    # dispatch on the bench device, PERF.md)
-    rh_n = jnp.sum(w_rdhit[:, :H] & in_burst, axis=1, dtype=jnp.int32)
-    wh_n = jnp.sum(w_wrhit[:, :H] & in_burst, axis=1, dtype=jnp.int32)
-    # burst write effects per line: last write in the burst wins; any
-    # write leaves the line MODIFIED (static H-step fold, all fused)
-    for k in range(H):
-        wmask = (w_wrhit[:, k] & in_burst[:, k])[:, None] & w_onehot[:, k]
-        cv = jnp.where(wmask, w_val[:, k][:, None], cv)
-        cs = jnp.where(wmask, int(CacheState.MODIFIED), cs)
+    if cfg.pallas_burst and cfg.procedural and not with_events:
+        # ---- phases 1-2a as ONE fused Pallas kernel (ops.pallas_burst;
+        # flag-gated — see that module's docstring for the economics)
+        from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_burst
+        d, rh_n, wh_n, oa, val, live, cv, cs = pallas_burst.burst(
+            cfg, ca, cv, cs, idx0, st.instr_count)
+    else:
+        # ---- instruction window: burst of up to H hits + stopped instr —
+        # ONE flat gather for the whole window and both fields (idx
+        # advances by at most 1 per burst step, so H+1 lookahead always
+        # suffices); procedural mode computes the window instead — no
+        # trace storage
+        offs = jnp.arange(H + 1, dtype=jnp.int32)[None, :]      # [1, H+1]
+        w_idx = idx0[:, None] + offs                             # [N, H+1]
+        w_live = w_idx < st.instr_count[:, None]
+        if cfg.procedural:
+            w_oa, w_val = procedural_instr(cfg, rows[:, None], w_idx)
+        else:
+            w_flat = rows[:, None] * T + jnp.minimum(w_idx, T - 1)
+            w = st.instr_pack.reshape(N * T, 2)[w_flat]          # [N,H+1,2]
+            w_oa, w_val = w[..., 0], w[..., 1]
 
-    # ---- phase 2: classify the stopped instruction as a transaction ------
-    d_onehot = offs == d[:, None]                                 # [N, H+1]
-    pick = lambda arr: jnp.sum(jnp.where(d_onehot, arr, 0), axis=1)
-    oa = pick(w_oa)
-    val = pick(w_val)
-    live = jnp.sum(jnp.where(d_onehot, w_live, False), axis=1).astype(bool)
+        # ---- phase 1: hit burst (node-local, no cross-node effects) ------
+        # Vectorized over the whole window at once: within a burst only
+        # hits execute, and hits never change any line's tag or hit/miss
+        # class (a write hit needs M/E and leaves M — still a write hit;
+        # values change, classifications don't). So every window position
+        # can be classified against the round-start cache, and the burst
+        # length is the length of the leading all-hit prefix.
+        w_op, w_addr = w_oa >> 28, w_oa & 0x0FFFFFFF             # [N, H+1]
+        w_ci = codec.cache_index(cfg, w_addr)
+        w_onehot = w_ci[:, :, None] == c_iota[None, None, :]     # [N,H+1,C]
+        pick3 = lambda arr: jnp.sum(
+            jnp.where(w_onehot, arr[:, None, :], 0), axis=2)     # [N, H+1]
+        wl_addr, wl_state = pick3(ca), pick3(cs)
+        w_tagok = (wl_addr == w_addr) & (wl_state != INV)
+        w_rdhit = w_live & (w_op == int(Op.READ)) & w_tagok
+        w_wrhit = w_live & (w_op == int(Op.WRITE)) & w_tagok & (
+            (wl_state == int(CacheState.MODIFIED))
+            | (wl_state == int(CacheState.EXCLUSIVE)))
+        # in-trace NOPs (malformed trace lines, utils.trace) retire with
+        # no effect, like the reference's fall-through on unknown type
+        w_nop = w_live & (w_op == int(Op.NOP))
+        w_hit = w_rdhit | w_wrhit | w_nop
+        # leading all-hit prefix over the first H positions (the H+1-th
+        # slot is only ever the transaction candidate)
+        prefix = jnp.cumprod(w_hit[:, :H].astype(jnp.int32), axis=1)
+        d = jnp.sum(prefix, axis=1)                               # [N] <= H
+        in_burst = prefix.astype(bool)                            # [N, H]
+        # burst hit counts per node (summed with the other metrics below
+        # in one stacked reduction — separate jnp.sum calls each cost a
+        # kernel dispatch on the bench device, PERF.md)
+        rh_n = jnp.sum(w_rdhit[:, :H] & in_burst, axis=1,
+                       dtype=jnp.int32)
+        wh_n = jnp.sum(w_wrhit[:, :H] & in_burst, axis=1,
+                       dtype=jnp.int32)
+        # burst write effects per line: last write in the burst wins; any
+        # write leaves the line MODIFIED (static H-step fold, all fused)
+        for k in range(H):
+            wmask = ((w_wrhit[:, k] & in_burst[:, k])[:, None]
+                     & w_onehot[:, k])
+            cv = jnp.where(wmask, w_val[:, k][:, None], cv)
+            cs = jnp.where(wmask, int(CacheState.MODIFIED), cs)
+
+        # ---- phase 2: classify the stopped instruction ------------------
+        d_onehot = offs == d[:, None]                             # [N, H+1]
+        pick = lambda arr: jnp.sum(jnp.where(d_onehot, arr, 0), axis=1)
+        oa = pick(w_oa)
+        val = pick(w_val)
+        live = jnp.sum(jnp.where(d_onehot, w_live, False),
+                       axis=1).astype(bool)
     op, addr = oa >> 28, oa & 0x0FFFFFFF
     ci = codec.cache_index(cfg, addr)
     onehot_ci = ci[:, None] == c_iota[None, :]                    # [N, C]

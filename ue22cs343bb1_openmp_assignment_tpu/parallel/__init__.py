@@ -1,9 +1,10 @@
 from ue22cs343bb1_openmp_assignment_tpu.parallel.mesh import (
     make_mesh, make_multihost_mesh, state_shardings, shard_state)
 from ue22cs343bb1_openmp_assignment_tpu.parallel.sharded_step import (
-    make_sharded_cycle, make_sharded_round, make_sharded_runner)
+    make_sharded_cycle, make_sharded_round,
+    make_sharded_round_runner, make_sharded_runner)
 
 __all__ = ["make_mesh", "make_multihost_mesh",
            "state_shardings", "shard_state",
            "make_sharded_cycle", "make_sharded_round",
-           "make_sharded_runner"]
+           "make_sharded_round_runner", "make_sharded_runner"]

@@ -59,3 +59,27 @@ def make_sharded_round(cfg: SystemConfig, mesh, example_state):
     sh = state_shardings(cfg, mesh, example_state)
     return jax.jit(lambda s: round_step(cfg, s), in_shardings=(sh,),
                    out_shardings=sh)
+
+
+def make_sharded_round_runner(cfg: SystemConfig, mesh, example_state,
+                              num_rounds: int):
+    """jit a `num_rounds`-round transactional scan with node-axis
+    shardings — the multi-chip twin of
+    ops.sync_engine.run_rounds (same read-only instruction-table hoist,
+    one dispatch for the whole run)."""
+    from ue22cs343bb1_openmp_assignment_tpu.ops.sync_engine import (
+        _pack_outside, round_step)
+    sh = state_shardings(cfg, mesh, example_state)
+
+    @functools.partial(jax.jit, in_shardings=(sh,), out_shardings=sh)
+    def run(state):
+        carry0, pack = _pack_outside(state)
+
+        def body(s, _):
+            out = round_step(cfg, s.replace(instr_pack=pack))
+            return out.replace(instr_pack=carry0.instr_pack), None
+
+        final, _ = jax.lax.scan(body, carry0, None, length=num_rounds)
+        return final.replace(instr_pack=pack)
+
+    return run
