@@ -67,3 +67,36 @@ def test_three_engines_agree_on_local_traffic(seed, num_nodes, n_instrs):
                                       f"{name}: async vs sync")
         np.testing.assert_array_equal(np.asarray(av), np.asarray(nv),
                                       f"{name}: async vs native")
+
+
+@pytest.mark.parametrize("seed,width", [(4, 2), (5, 4)])
+def test_multi_txn_windows_agree_with_native(seed, width):
+    """Multi-transaction windows vs the C++ oracle on schedule-
+    independent (node-local) traffic: the composed windows must land
+    the same final state as the message-level native engine."""
+    cfg = SystemConfig.reference(num_nodes=8, txn_width=width)
+    rng = np.random.default_rng(seed)
+    traces = local_traces(rng, cfg, 30)
+
+    s = se.run_sync_to_quiescence(
+        cfg, se.from_sim_state(cfg, init_state(cfg, traces)), 8, 50_000)
+    assert bool(s.quiescent())
+    se.check_exact_directory(cfg, s)
+
+    nat = NativeEngine(cfg)
+    nat.load_traces(traces)
+    nat.run(1_000_000)
+    assert nat.quiescent
+    n_st = nat.export_state()
+
+    s_mem, s_ds, s_bv = se.to_sim_arrays(cfg, s)
+    for name, sv, nv in [
+        ("cache_addr", s.cache_addr, n_st["cache_addr"]),
+        ("cache_val", s.cache_val, n_st["cache_val"]),
+        ("cache_state", s.cache_state, n_st["cache_state"]),
+        ("memory", s_mem, n_st["memory"]),
+        ("dir_state", s_ds, n_st["dir_state"]),
+        ("dir_bitvec", s_bv, n_st["dir_bitvec"]),
+    ]:
+        np.testing.assert_array_equal(np.asarray(sv), np.asarray(nv),
+                                      f"{name}: sync(K={width}) vs native")
