@@ -104,6 +104,41 @@ def dequeue(cfg: SystemConfig, state) -> tuple:
     return view, new_head, new_count
 
 
+def candidate_prio(cfg: SystemConfig, arb_rank) -> jnp.ndarray:
+    """[N, S] global arbitration priority of each candidate: sender's
+    arbitration rank, then program-order slot. THE delivery order — the
+    explicit shard_map router (parallel/shardmap_comm.py) ships it
+    across shards so routed and global delivery sort identically."""
+    S = cfg.out_slots
+    return (arb_rank.astype(jnp.int32)[:, None] * S
+            + jnp.arange(S, dtype=jnp.int32)[None, :])
+
+
+def pack_candidates(cand: Candidates) -> jnp.ndarray:
+    """[N, S, 6 + Wm] i32 payload rows, the exact layout the ring
+    scatter writes (shared with the shard_map router)."""
+    flat = jnp.stack([cand.type, cand.sender, cand.addr, cand.value,
+                      cand.second, cand.dirstate], axis=-1)
+    bv = jax.lax.bitcast_convert_type(cand.bitvec, jnp.int32)
+    return jnp.concatenate([flat, bv.reshape(*flat.shape[:2], -1)],
+                           axis=-1)
+
+
+def segment_ranks(bucket, valid):
+    """(rank, seg_start) of each row within its bucket run.
+
+    `bucket`/`valid` must already be sorted so equal buckets are
+    adjacent with invalid rows last; rank counts 0.. within each run
+    (the enqueue position / lane slot). Shared by ops.mailbox.deliver
+    and parallel/shardmap_comm.make_router."""
+    F = bucket.shape[0]
+    idx = jnp.arange(F, dtype=jnp.int32)
+    is_start = jnp.concatenate(
+        [jnp.array([True]), (bucket[1:] != bucket[:-1]) | ~valid[1:]])
+    seg_start = jax_cummax(jnp.where(is_start, idx, -1))
+    return idx - seg_start, seg_start
+
+
 def deliver(cfg: SystemConfig, state, cand: Candidates, arb_rank,
             new_head, new_count):
     """Scatter candidates into the rings with deterministic arbitration.
@@ -130,9 +165,7 @@ def deliver(cfg: SystemConfig, state, cand: Candidates, arb_rank,
     # the capacity gather below never reads a clamped index and the
     # native engine's matching guard (engine.cpp deliver) stays exact.
     valid = (c_type != int(Msg.NONE)) & (recv >= 0) & (recv < N)
-    # priority: sender's arbitration rank, then program order (slot)
-    prio = arb_rank.astype(jnp.int32)[:, None] * S + jnp.arange(S)[None, :]
-    prio = prio.reshape(F)
+    prio = candidate_prio(cfg, arb_rank).reshape(F)
 
     # group candidates by receiver in arbitration order
     if N * (F + 1) + F < 2**31:
@@ -151,12 +184,7 @@ def deliver(cfg: SystemConfig, state, cand: Candidates, arb_rank,
     v_s = valid[order]
 
     # rank within each receiver's run of the sorted array
-    idx = jnp.arange(F, dtype=jnp.int32)
-    is_start = jnp.concatenate([jnp.array([True]),
-                                (r_s[1:] != r_s[:-1]) | ~v_s[1:]])
-    # positions where a new receiver run starts; cummax propagates start idx
-    seg_start = jax_cummax(jnp.where(is_start, idx, -1))
-    rank = idx - seg_start
+    rank, seg_start = segment_ranks(r_s, v_s)
 
     # capacity: free slots after this cycle's dequeue
     safe_r = jnp.where(v_s, r_s, 0)
@@ -186,12 +214,7 @@ def deliver(cfg: SystemConfig, state, cand: Candidates, arb_rank,
 
     # pack the candidate fields into message rows; the whole delivery is
     # then ONE scatter of [F, 6 + Wm] rows
-    pack = jnp.concatenate(
-        [jnp.stack([cand.type, cand.sender, cand.addr,
-                    cand.value, cand.second, cand.dirstate],
-                   axis=-1).reshape(F, 6),
-         jax.lax.bitcast_convert_type(cand.bitvec, jnp.int32).reshape(F, -1)],
-        axis=1)[order]
+    pack = pack_candidates(cand).reshape(F, -1)[order]
 
     updates = dict(
         mb_pack=state.mb_pack.at[tgt_r, tgt_p].set(pack, mode="drop"),

@@ -12,6 +12,9 @@ node dimension (axis 0 of every SimState array), sharded over a 1-D
   message's receiver lives on another device; under `jit` XLA/GSPMD
   lowers that into all-to-all/collective-permute traffic on ICI (DCN
   across hosts) — the framework's distributed communication backend.
+  The same transport written explicitly (shard_map + one
+  `jax.lax.all_to_all` lane exchange per step) lives in
+  parallel/shardmap_comm.py.
 """
 
 from __future__ import annotations

@@ -1,0 +1,145 @@
+"""Explicit cross-shard message routing: shard_map + all_to_all.
+
+The engines' default transport is implicit: `cycle`/`round_step` are
+jitted with node-axis shardings and GSPMD lowers the delivery scatter's
+cross-shard writes into collectives (parallel/sharded_step.py). This
+module is the same communication backend written *explicitly* — the
+reference's locked mailboxes (``assignment.c:741-765``) re-expressed as
+the canonical TPU recipe the survey maps them to (SURVEY §2
+"parallelism strategies"): shard the node axis over a
+`jax.sharding.Mesh`, bucket each shard's outgoing messages by
+destination shard, and exchange the buckets with ONE
+`jax.lax.all_to_all` over the ICI axis. Useful as the hand-rolled
+transport for experiments the implicit path cannot express (per-link
+accounting, custom routing policies, DCN/ICI split studies) and as an
+executable specification of what GSPMD generates.
+
+Routing preserves exactly what the global delivery sort keys on
+(ops/mailbox.deliver): each candidate travels with its global
+arbitration priority `prio = arb_rank[sender] * out_slots + slot`, and
+per-receiver enqueue order is recovered by sorting inbound candidates
+on (receiver, prio) — a total key, so the routed path reproduces the
+global path's rings bit for bit (tests/test_shardmap_comm.py).
+
+Capacity: each (source shard -> dest shard) lane carries up to
+`lane_cap` message rows per exchange (default: all of a shard's
+out-slots, i.e. lossless). A fuller lane is truncated in priority
+order and reported, mirroring the bounded-mailbox drop accounting of
+the engine proper.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.ops.mailbox import (
+    Candidates, candidate_prio, pack_candidates, segment_ranks)
+from ue22cs343bb1_openmp_assignment_tpu.parallel.mesh import AXIS
+from ue22cs343bb1_openmp_assignment_tpu.types import Msg
+
+# the delivery-order/payload definitions are owned by ops.mailbox
+# (deliver calls the same two functions), re-exported here for router
+# callers
+pack_fields = pack_candidates
+
+
+class RoutedMsgs(NamedTuple):
+    """Per-shard inbound candidates after the all-to-all exchange.
+
+    Leading axis is the (sharded) lane pool: D * lane_cap rows per
+    shard. `valid` marks real messages; `recv` is the global receiver
+    id (always owned by the local shard); `prio` is the sender-side
+    global arbitration priority (total order per receiver)."""
+
+    valid: jnp.ndarray    # [D * lane_cap] bool
+    recv: jnp.ndarray     # [D * lane_cap] i32
+    prio: jnp.ndarray     # [D * lane_cap] i32
+    fields: jnp.ndarray   # [D * lane_cap, 6 + Wm] i32 packed payload
+    truncated: jnp.ndarray  # [] i32: messages dropped to lane caps
+
+
+def make_router(cfg: SystemConfig, mesh: Mesh, lane_cap: int | None = None):
+    """Build `route(cand_type, recv, prio, fields) -> RoutedMsgs`.
+
+    Inputs are node-sharded [N, S] / [N, S, F] arrays; the result's
+    lane pool is likewise sharded (each shard holds its own inbound
+    rows). One all_to_all over the 'nodes' mesh axis per call."""
+    if mesh.axis_names != (AXIS,):
+        # ownership math below assumes the node axis shards over ONE
+        # mesh axis; a (hosts, nodes) mesh partitions nodes over both
+        # (mesh.state_shardings), which would silently misroute
+        raise ValueError(
+            f"make_router needs a 1-D ('{AXIS}',) mesh, got "
+            f"{mesh.axis_names}; flatten a multi-host device grid into "
+            "one axis for explicit routing")
+    D = mesh.shape[AXIS]
+    N, S = cfg.num_nodes, cfg.out_slots
+    if N % D:
+        raise ValueError(f"{N} nodes do not shard over {D} devices")
+    L = N // D                      # nodes per shard
+    cap = lane_cap if lane_cap is not None else L * S
+    Fw = 6 + cfg.msg_bitvec_words
+
+    def local_route(ctype, recv, prio, fields):
+        # shapes: [L, S], [L, S], [L, S], [L, S, Fw]
+        F = L * S
+        ctype, recv, prio = (ctype.reshape(F), recv.reshape(F),
+                             prio.reshape(F))
+        fields = fields.reshape(F, Fw)
+        valid = (ctype != int(Msg.NONE)) & (recv >= 0) & (recv < N)
+        dest = jnp.where(valid, recv // L, D)      # dest shard (D = none)
+        # order by (dest, prio): a fused total key — F * prio ranges
+        # within int32 at simulator scales (F = L * S, prio < N * S)
+        key = jnp.where(valid, dest * (N * S) + prio,
+                        jnp.iinfo(jnp.int32).max)
+        order = jnp.argsort(key)
+        d_s = dest[order]
+        v_s = valid[order]
+        # rank within each destination bucket (shared with deliver)
+        rank, _ = segment_ranks(d_s, v_s)
+        fit = v_s & (rank < cap)
+        truncated = jnp.sum(v_s & ~fit).astype(jnp.int32)
+        # outbox lanes: [D, cap] rows per destination shard
+        tgt_d = jnp.where(fit, d_s, D)
+        tgt_r = jnp.where(fit, rank, 0)
+        ob_valid = jnp.zeros((D, cap), bool).at[tgt_d, tgt_r].set(
+            fit, mode="drop")
+        ob_recv = jnp.zeros((D, cap), jnp.int32).at[tgt_d, tgt_r].set(
+            recv[order], mode="drop")
+        ob_prio = jnp.zeros((D, cap), jnp.int32).at[tgt_d, tgt_r].set(
+            prio[order], mode="drop")
+        ob_fields = jnp.zeros((D, cap, Fw), jnp.int32).at[
+            tgt_d, tgt_r].set(fields[order], mode="drop")
+        # THE collective: lane d of this shard's outbox becomes lane
+        # <this shard> of shard d's inbox — ICI traffic, one exchange
+        ib_valid, ib_recv, ib_prio, ib_fields = [
+            jax.lax.all_to_all(x, AXIS, split_axis=0, concat_axis=0,
+                               tiled=True)
+            for x in (ob_valid.astype(jnp.int32), ob_recv, ob_prio,
+                      ob_fields)]
+        ib_valid = ib_valid.astype(bool)
+        return (ib_valid.reshape(D * cap), ib_recv.reshape(D * cap),
+                ib_prio.reshape(D * cap),
+                ib_fields.reshape(D * cap, Fw),
+                jax.lax.psum(truncated, AXIS)[None])
+
+    routed_specs = (P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS))
+
+    @jax.jit
+    def route(ctype, recv, prio, fields) -> RoutedMsgs:
+        out = shard_map(
+            local_route, mesh=mesh,
+            in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+            out_specs=routed_specs)(ctype, recv, prio, fields)
+        return RoutedMsgs(out[0], out[1], out[2], out[3], out[4][0])
+
+    return route
+
+
