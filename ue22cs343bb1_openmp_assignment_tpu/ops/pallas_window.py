@@ -183,25 +183,28 @@ def _window_kernel(cfg, T, ca_ref, cv_ref, cs_ref, idx_ref, cnt_ref,
             rows.append(acc)
         return jnp.concatenate(rows, axis=0)                  # [K, T]
 
-    outs = [slot_rows(f) for f in _SLOT_FIELDS]
+    slot_blocks = [slot_rows(f) for f in _SLOT_FIELDS]
     pos_rows = []
     for j in range(K):
         acc = jnp.zeros((1, T), jnp.int32)
         for k in range(W):
             acc = jnp.where(sel[j][k], k, acc)
         pos_rows.append(acc)
-    outs.append(jnp.concatenate(pos_rows, axis=0))            # pos [K, T]
-    for f in _STEP_FIELDS:
-        outs.append(jnp.concatenate(
-            [steps[k][f].astype(jnp.int32) for k in range(W)], axis=0))
-    outs.append(jnp.concatenate(cv_pre, axis=0))              # [C, T]
-    for ref, value in zip(out_refs, outs):
-        ref[...] = value
+    slot_blocks.append(jnp.concatenate(pos_rows, axis=0))     # pos [K, T]
+    step_blocks = [
+        jnp.concatenate([steps[k][f].astype(jnp.int32)
+                         for k in range(W)], axis=0)
+        for f in _STEP_FIELDS]
+    # pack into THREE outputs (each pallas output buffer pays a layout
+    # copy at the call boundary on this device)
+    slot_ref, step_ref, cvp_ref = out_refs
+    slot_ref[...] = jnp.concatenate(slot_blocks, axis=0)  # [13K, T]
+    step_ref[...] = jnp.concatenate(step_blocks, axis=0)  # [3W, T]
+    cvp_ref[...] = jnp.concatenate(cv_pre, axis=0)        # [C, T]
 
 
 def _replay_kernel(cfg, T, ca_ref, cv_ref, cs_ref, idx_ref, cnt_ref,
-                   fl_ref, fs_ref, fv_ref,
-                   cao_ref, cvo_ref, cso_ref, nr_ref, rh_ref, wh_ref):
+                   fl_ref, fs_ref, fv_ref, cache_ref, cnts_ref):
     C, K = cfg.cache_size, cfg.txn_width
     W = cfg.drain_depth + K
     MOD = int(CacheState.MODIFIED)
@@ -236,12 +239,8 @@ def _replay_kernel(cfg, T, ca_ref, cv_ref, cs_ref, idx_ref, cnt_ref,
             ca_c[c] = jnp.where(fm, s["addr"], ca_c[c])
             cv_c[c] = jnp.where(fm, fv, cv_c[c])
             cs_c[c] = jnp.where(fm, fs, cs_c[c])
-    cao_ref[...] = jnp.concatenate(ca_c, axis=0)
-    cvo_ref[...] = jnp.concatenate(cv_c, axis=0)
-    cso_ref[...] = jnp.concatenate(cs_c, axis=0)
-    nr_ref[...] = n_ret
-    rh_ref[...] = rh
-    wh_ref[...] = wh
+    cache_ref[...] = jnp.concatenate(ca_c + cv_c + cs_c, axis=0)
+    cnts_ref[...] = jnp.concatenate([n_ret, rh, wh], axis=0)
 
 
 from ue22cs343bb1_openmp_assignment_tpu.ops.pallas_burst import (
@@ -255,19 +254,19 @@ def _call_window(cfg, ca_t, cv_t, cs_t, idx2, cnt2):
     T = _tile(N)
     vec = pl.BlockSpec((1, T), lambda i: (0, i))
     matC = pl.BlockSpec((C, T), lambda i: (0, i))
-    matK = pl.BlockSpec((K, T), lambda i: (0, i))
-    matW = pl.BlockSpec((W, T), lambda i: (0, i))
-    sK = jax.ShapeDtypeStruct((K, N), jnp.int32)
-    sW = jax.ShapeDtypeStruct((W, N), jnp.int32)
-    sC = jax.ShapeDtypeStruct((C, N), jnp.int32)
     n_slot = len(_SLOT_FIELDS) + 1          # + pos
     n_step = len(_STEP_FIELDS)
+    blk = lambda rows: (pl.BlockSpec((rows, T), lambda i: (0, i)),
+                        jax.ShapeDtypeStruct((rows, N), jnp.int32))
+    slot_spec, slot_shape = blk(n_slot * K)
+    step_spec, step_shape = blk(n_step * W)
+    cvp_spec, cvp_shape = blk(C)
     return pl.pallas_call(
         functools.partial(_window_kernel, cfg, T),
         grid=(N // T,),
         in_specs=[matC] * 3 + [vec] * 2,
-        out_specs=[matK] * n_slot + [matW] * n_step + [matC],
-        out_shape=[sK] * n_slot + [sW] * n_step + [sC],
+        out_specs=[slot_spec, step_spec, cvp_spec],
+        out_shape=[slot_shape, step_shape, cvp_shape],
         interpret=_interpret(),
     )(ca_t, cv_t, cs_t, idx2, cnt2)
 
@@ -280,14 +279,16 @@ def _call_replay(cfg, ca_t, cv_t, cs_t, idx2, cnt2, first_lose,
     vec = pl.BlockSpec((1, T), lambda i: (0, i))
     matC = pl.BlockSpec((C, T), lambda i: (0, i))
     matK = pl.BlockSpec((K, T), lambda i: (0, i))
-    sV = jax.ShapeDtypeStruct((1, N), jnp.int32)
-    sC = jax.ShapeDtypeStruct((C, N), jnp.int32)
+    blk = lambda rows: (pl.BlockSpec((rows, T), lambda i: (0, i)),
+                        jax.ShapeDtypeStruct((rows, N), jnp.int32))
+    cache_spec, cache_shape = blk(3 * C)
+    cnts_spec, cnts_shape = blk(3)
     return pl.pallas_call(
         functools.partial(_replay_kernel, cfg, T),
         grid=(N // T,),
         in_specs=[matC] * 3 + [vec] * 2 + [vec] + [matK] * 2,
-        out_specs=[matC] * 3 + [vec] * 3,
-        out_shape=[sC] * 3 + [sV] * 3,
+        out_specs=[cache_spec, cnts_spec],
+        out_shape=[cache_shape, cnts_shape],
         interpret=_interpret(),
     )(ca_t, cv_t, cs_t, idx2, cnt2, first_lose, fill_state, fill_val)
 
@@ -313,11 +314,13 @@ def round_step_multi_pallas(cfg: SystemConfig, st: SyncState) -> SyncState:
     idx2 = st.idx[None, :]
     cnt2 = st.instr_count[None, :]
 
-    outs = _call_window(cfg, ca_t, cv_t, cs_t, idx2, cnt2)
-    n_slot = len(_SLOT_FIELDS) + 1
-    slot = dict(zip(_SLOT_FIELDS + ("pos",), outs[:n_slot]))
-    hc_w, dep_w, he_w = outs[n_slot:n_slot + 3]
-    cv_pre = outs[-1]                                        # [C, N]
+    slotmat, stepmat, cv_pre = _call_window(cfg, ca_t, cv_t, cs_t,
+                                            idx2, cnt2)
+    slot = {f: slotmat[i * K:(i + 1) * K]
+            for i, f in enumerate(_SLOT_FIELDS + ("pos",))}
+    W = cfg.drain_depth + K
+    hc_w, dep_w, he_w = (stepmat[:W], stepmat[W:2 * W],
+                         stepmat[2 * W:])                    # [W, N] each
 
     exists = slot["ok"].astype(bool)                         # [K, N]
     e1_s, e2_s = slot["e1"], slot["e2"]
@@ -341,7 +344,6 @@ def round_step_multi_pallas(cfg: SystemConfig, st: SyncState) -> SyncState:
     # costs more than the copies it avoids
     dm_claimed = st.dm.at[c_idx, DM_CLAIM].min(jnp.tile(key, 2 * K),
                                                mode="drop")
-    W = cfg.drain_depth + K
     g = dm_claimed[jnp.concatenate(
         [e1_s, e2_s, he_w], axis=0).reshape(-1)].reshape(2 * K + W, N,
                                                          DM_COLS)
@@ -466,10 +468,12 @@ def round_step_multi_pallas(cfg: SystemConfig, st: SyncState) -> SyncState:
     # ---- replay kernel ----------------------------------------------------
     fill_state = jnp.where(rd_s, jnp.where(d_u, EXC, SHD), MOD)
     fill_val = jnp.where(rd_s, jnp.where(d_em, val_o, d1m), val_s)
-    ca_c, cv_c, cs_c, n_ret2, rh2, wh2 = _call_replay(
+    cache_mat, cnts = _call_replay(
         cfg, ca_t, cv_t, cs_t, idx2, cnt2, first_lose[None, :],
         fill_state, fill_val)
-    n_retired, rh_n, wh_n = n_ret2[0], rh2[0], wh2[0]
+    ca_c, cv_c, cs_c = (cache_mat[:C], cache_mat[C:2 * C],
+                        cache_mat[2 * C:])
+    n_retired, rh_n, wh_n = cnts[0], cnts[1], cnts[2]
 
     # ---- fan-out application (transposed [C, N]) --------------------------
     line_e = jnp.clip(ca_c, 0, E - 1)                        # [C, N]
