@@ -153,3 +153,14 @@ def test_wider_windows_take_fewer_rounds():
     # all-local traffic never conflicts: the wide window should cut
     # rounds by at least 3x on a miss-heavy uniform trace
     assert rounds[8] * 3 <= rounds[1], rounds
+
+
+def test_non_power_of_two_nodes():
+    """Window machinery must not assume power-of-two node counts
+    (claim priority bits, entry strides, clip bounds)."""
+    cfg = SystemConfig.scale(num_nodes=24, txn_width=3, drain_depth=3)
+    sys_ = CoherenceSystem.from_workload(cfg, "uniform", trace_len=40,
+                                         seed=6, local_frac=0.5)
+    final = run_to_quiescence(cfg, se.from_sim_state(cfg, sys_.state))
+    se.check_exact_directory(cfg, final)
+    assert int(final.metrics.instrs_retired) == 24 * 40

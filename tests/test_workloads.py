@@ -100,3 +100,34 @@ def test_hotspot_temporal_locality():
     hits = int(m.read_hits) + int(m.write_hits)
     misses = int(m.read_misses) + int(m.write_misses) + int(m.upgrades)
     assert hits > misses, (hits, misses)  # temporal locality pays off
+
+
+def test_lu_writes_are_node_local_reads_share_pivots():
+    """LU-style blocked factorization: all writes hit the writer's own
+    home blocks (no write races), while each phase's pivot block is
+    read by every node (wide sharer sets)."""
+    import jax
+    from ue22cs343bb1_openmp_assignment_tpu import codec
+    cfg = SystemConfig.scale(num_nodes=16)
+    op, addr, val, cnt = workloads.lu_blocked(
+        jax.random.PRNGKey(0), cfg, 32)
+    import numpy as np
+    op, addr = np.asarray(op), np.asarray(addr)
+    home = addr >> cfg.block_bits
+    ids = np.arange(16)[:, None]
+    assert (home[op == 1] == np.broadcast_to(ids, op.shape)[op == 1]).all()
+    # slot-0 columns: one pivot address shared by every node
+    pivot_cols = addr[:, 0::4]
+    assert (pivot_cols == pivot_cols[0]).all()
+
+
+def test_lu_runs_to_quiescence_with_exact_directory():
+    from ue22cs343bb1_openmp_assignment_tpu.models.system import (
+        CoherenceSystem)
+    from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+    cfg = SystemConfig.scale(num_nodes=32, txn_width=3, drain_depth=3)
+    sys_ = CoherenceSystem.from_workload(cfg, "lu", trace_len=40, seed=2)
+    final = se.run_sync_to_quiescence(
+        cfg, se.from_sim_state(cfg, sys_.state), 16, 50_000)
+    assert bool(final.quiescent())
+    se.check_exact_directory(cfg, final)

@@ -170,6 +170,43 @@ def hotspot(key, cfg: SystemConfig, trace_len: int,
     return op, addr, val, jnp.full((N,), trace_len, jnp.int32)
 
 
+def lu_blocked(key, cfg: SystemConfig, trace_len: int):
+    """SPLASH-2 LU-style blocked-factorization reference pattern.
+
+    Phase k of blocked LU: the pivot block (owned by node k mod N) is
+    read by every node factoring a block of pivot row/column k; each
+    node then updates (reads + writes) its own trailing blocks. Per
+    instruction slot t: phase = t // 4; slot 0 reads the phase's pivot
+    block (a broadcast hot read — wide sharer sets, the pattern that
+    stresses invalidation fan-out when the next phase's owner upgrades
+    it), slot 1 reads the node's row-pivot block, slots 2-3
+    read-then-write a local trailing block. Deterministic homes, racy
+    only on the shared pivot reads.
+    """
+    N = cfg.num_nodes
+    k1, k2 = jax.random.split(key)
+    shape = (N, trace_len)
+    ids = jnp.arange(N, dtype=jnp.int32)[:, None]
+    t = jnp.arange(trace_len, dtype=jnp.int32)[None, :]
+    phase = t // 4
+    slot = t % 4
+    pivot_owner = phase % N
+    pivot_block = phase % cfg.mem_size
+    row_owner = (phase + ids) % N
+    local_block = jax.random.randint(k1, shape, 0, cfg.mem_size,
+                                     dtype=jnp.int32)
+    node = jnp.where(slot == 0, pivot_owner,
+                     jnp.where(slot == 1, row_owner, ids))
+    block = jnp.where(slot <= 1, jnp.broadcast_to(pivot_block, shape),
+                      local_block)
+    addr = codec.make_address(cfg, node, block)
+    op = jnp.where(slot == 3, int(Op.WRITE),
+                   int(Op.READ)).astype(jnp.int32)
+    op = jnp.broadcast_to(op, shape)
+    val = jax.random.randint(k2, shape, 0, 256, dtype=jnp.int32)
+    return op, addr, val, jnp.full((N,), trace_len, jnp.int32)
+
+
 def procedural_uniform(key, cfg: SystemConfig, trace_len: int):
     """Materialized twin of the sync engine's procedural 'uniform'
     source (ops.sync_engine.procedural_instr): identical instructions,
@@ -193,6 +230,7 @@ GENERATORS = {
     "false_sharing": false_sharing,
     "fft": fft_transpose,
     "radix": radix_sort,
+    "lu": lu_blocked,
     "hotspot": hotspot,
     "procedural_uniform": procedural_uniform,
 }
