@@ -1,0 +1,279 @@
+"""Probe: claim column as a separate dense [E] array vs the dm column.
+
+The round's claim scatter-min writes a strided column of the [E, 7]
+directory table, which makes XLA keep a transposed copy of the table
+(PERF.md). This probe carries the claim column as its own [E] array in
+the runner loop (scatter-min on a dense array, claims gathered
+separately), leaving the table gather 7-wide but un-flipped. Run on
+the TPU backend:
+
+    python scripts/prof_claimsplit.py
+"""
+
+import dataclasses
+import functools
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ue22cs343bb1_openmp_assignment_tpu import codec
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+from ue22cs343bb1_openmp_assignment_tpu.ops.pallas_window import (
+    _SLOT_FIELDS, _call_replay, _call_window)
+from ue22cs343bb1_openmp_assignment_tpu.ops.sync_engine import (
+    ACT_DOWNGRADE, ACT_KILL, ACT_NONE, ACT_PROMOTE, DM_ACT, DM_COLS,
+    DM_COUNT, DM_MEM, DM_OWNER, DM_REQ, DM_STATE, _round_key,
+    claim_max_rounds)
+from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState
+
+
+def round_split(cfg, st, claim):
+    """round_step_multi_pallas with the claim column split out."""
+    N, C = cfg.num_nodes, cfg.cache_size
+    K = cfg.txn_width
+    E = N << cfg.block_bits
+    INV = int(CacheState.INVALID)
+    MOD = int(CacheState.MODIFIED)
+    EXC = int(CacheState.EXCLUSIVE)
+    SHD = int(CacheState.SHARED)
+    rows0 = jnp.arange(N, dtype=jnp.int32)
+
+    ca_t, cv_t, cs_t = (st.cache_addr.T, st.cache_val.T,
+                        st.cache_state.T)
+    idx2, cnt2 = st.idx[None, :], st.instr_count[None, :]
+    slotmat, stepmat, cv_pre = _call_window(cfg, ca_t, cv_t, cs_t,
+                                            idx2, cnt2)
+    slot = {f: slotmat[i * K:(i + 1) * K]
+            for i, f in enumerate(_SLOT_FIELDS + ("pos",))}
+    W = cfg.drain_depth + K
+    hc_w, dep_w, he_w = (stepmat[:W], stepmat[W:2 * W], stepmat[2 * W:])
+
+    exists = slot["ok"].astype(bool)
+    e1_s, e2_s = slot["e1"], slot["e2"]
+    val_s, v_val_s = slot["val"], slot["v_val"]
+    victim_s = slot["victim"].astype(bool)
+    rd_s, wr_s, up_s = (slot["rd"].astype(bool), slot["wr"].astype(bool),
+                        slot["up"].astype(bool))
+    v_mod_s = slot["v_mod"].astype(bool) & victim_s
+    rel_s = jnp.where(exists, slot["rel_ordn"], K)
+    acqb_s = jnp.where(exists, slot["acq_basen"], K)
+    pos_s = slot["pos"]
+
+    key = _round_key(cfg, st, rows0)
+    c_idx = jnp.concatenate(
+        [jnp.where(exists[j], e1_s[j], E) for j in range(K)]
+        + [jnp.where(victim_s[j], e2_s[j], E) for j in range(K)])
+    claim = claim.at[c_idx].min(jnp.tile(key, 2 * K), mode="drop")
+    # rows from the table, claims from the dense array — two gathers
+    g = st.dm[jnp.concatenate([e1_s, e2_s], axis=0).reshape(-1)
+              ].reshape(2 * K, N, DM_COLS)
+    gc = claim[jnp.concatenate([e1_s, e2_s, he_w], axis=0).reshape(-1)
+               ].reshape(2 * K + W, N)
+    d1, d2 = g[:K], g[K:2 * K]
+    c1, c2, hgot = gc[:K], gc[K:2 * K], gc[2 * K:]
+    key1 = key[None, :]
+    win = exists & (c1 == key1) & (~victim_s | (c2 == key1))
+
+    d1s, d1c, d1o, d1m = (d1[..., DM_STATE], d1[..., DM_COUNT],
+                          d1[..., DM_OWNER], d1[..., DM_MEM])
+    d2c, d2o, d2m = d2[..., DM_COUNT], d2[..., DM_OWNER], d2[..., DM_MEM]
+    pe_m = jnp.where(v_mod_s, v_val_s, d2m)
+    base_u = jnp.zeros((K, N), bool)
+    base_m = jnp.zeros((K, N), jnp.int32)
+    for i in range(K):
+        m = acqb_s == i
+        base_u |= m
+        base_m = jnp.where(m, pe_m[i:i + 1], base_m)
+    d1s = jnp.where(base_u, int(DirState.U), d1s)
+    d1c = jnp.where(base_u, 0, d1c)
+    d1m = jnp.where(base_u, base_m, d1m)
+    d_u = d1s == int(DirState.U)
+    d_em = d1s == int(DirState.EM)
+
+    prio_bits = max(1, (N - 1).bit_length())
+    thresh = (jnp.maximum(claim_max_rounds(cfg) - st.round, 0) + 1) \
+        << prio_bits
+    first_bad_hit = jnp.full((N,), W, jnp.int32)
+    for k in range(W):
+        dep = dep_w[k]
+        dok = jnp.zeros((N,), bool)
+        for j in range(K):
+            dok |= (dep == j) & d_u[j]
+        unsafe = ((hc_w[k].astype(bool)
+                   & ~((hgot[k] >= thresh) | (hgot[k] == key)))
+                  | ((dep < K) & ~dok))
+        first_bad_hit = jnp.minimum(first_bad_hit,
+                                    jnp.where(unsafe, k, W))
+    eligible = win & (pos_s < first_bad_hit[None, :])
+    cum = []
+    run = jnp.ones((N,), bool)
+    for j in range(K):
+        run = run & (eligible[j] | ~exists[j])
+        cum.append(run)
+    cum = jnp.stack(cum, axis=0)
+    commit = exists & cum
+    first_lose = jnp.minimum(
+        jnp.min(jnp.where(exists & ~cum, pos_s, W), axis=0),
+        first_bad_hit)
+
+    rd_w, wr_w, up_w = commit & rd_s, commit & wr_s, commit & up_s
+    wlike = wr_w | up_w
+    ci_s = codec.cache_index(cfg, e1_s)
+    safe_o = jnp.clip(d1o, 0, N - 1)
+    val_o = cv_pre.reshape(-1)[ci_s * N + safe_o]
+    n1s = jnp.where(wlike | (rd_w & d_u), int(DirState.EM),
+                    int(DirState.S))
+    n1c = jnp.where(wlike | (rd_w & d_u), 1,
+                    jnp.where(rd_w & d_em, 2, d1c + 1))
+    n1o = jnp.where(wlike | (rd_w & d_u), rows0[None, :], d1o)
+    n1m = jnp.where((rd_w | wr_w) & d_em, val_o, d1m)
+    act1 = jnp.where(wlike, ACT_KILL,
+                     jnp.where(rd_w & d_em, ACT_DOWNGRADE, ACT_NONE))
+    ev = commit & victim_s
+    ev_mod = ev & v_mod_s
+    ev_sh = ev & ~ev_mod
+    n2c = jnp.where(ev_mod, 0, d2c - 1)
+    n2s = jnp.where(n2c == 0, int(DirState.U),
+                    jnp.where(n2c == 1, int(DirState.EM),
+                              int(DirState.S)))
+    n2m = jnp.where(ev_mod, v_val_s, d2m)
+    act2 = jnp.where(ev_sh & (n2c == 1), ACT_PROMOTE, ACT_NONE)
+
+    released = jnp.zeros((K, N), bool)
+    rel_val = jnp.zeros((K, N), jnp.int32)
+    rel_dirty = jnp.zeros((K, N), bool)
+    consumed = jnp.zeros((K, N), bool)
+    j_iota = jnp.arange(K, dtype=jnp.int32)[:, None]
+    for r in range(K):
+        m = commit[r:r + 1] & (rel_s[r:r + 1] == j_iota)
+        released |= m
+        rel_val = jnp.where(m, v_val_s[r:r + 1], rel_val)
+        rel_dirty |= m & v_mod_s[r:r + 1]
+        consumed |= commit[r:r + 1] & (acqb_s[r:r + 1] == j_iota)
+    rd_rel_s = released & rd_s & ~d_u & ~d_em
+    r1s = jnp.where(wlike | (rd_s & d_u), int(DirState.U),
+                    jnp.where(rd_s & d_em, int(DirState.EM),
+                              jnp.where(d1c == 1, int(DirState.EM),
+                                        int(DirState.S))))
+    r1c = jnp.where(wlike | (rd_s & d_u), 0,
+                    jnp.where(rd_s & d_em, 1, d1c))
+    r1m = jnp.where(wlike | rel_dirty, rel_val,
+                    jnp.where(rd_s & d_em, val_o, d1m))
+    r1a = jnp.where(wlike, ACT_KILL,
+                    jnp.where((rd_s & d_em) | (rd_rel_s & (d1c == 1)),
+                              ACT_PROMOTE, ACT_NONE))
+    n1s = jnp.where(released, r1s, n1s)
+    n1c = jnp.where(released, r1c, n1c)
+    n1o = jnp.where(released, d1o, n1o)
+    n1m = jnp.where(released, r1m, n1m)
+    act1 = jnp.where(released, r1a, act1)
+    ev_sep = ev & (rel_s == K) & ~consumed
+
+    rtag = st.round << 2
+    rowsK = jnp.broadcast_to(rows0[None, :], (K, N))
+    t_idx = jnp.concatenate([jnp.where(commit, e1_s, E).reshape(-1),
+                             jnp.where(ev_sep, e2_s, E).reshape(-1)])
+    # 6 live columns; the table's 7th (claim) column is dead here and
+    # written with zeros to keep DM_COLS layout
+    zK = jnp.zeros((K, N), jnp.int32)
+    t_dm = jnp.concatenate([
+        jnp.stack([n1s, n1c, n1o, n1m, rtag | act1, rowsK, zK],
+                  axis=-1).reshape(-1, DM_COLS),
+        jnp.stack([n2s, n2c, d2o, n2m, rtag | act2, rowsK, zK],
+                  axis=-1).reshape(-1, DM_COLS)])
+    dm = st.dm.at[t_idx].set(t_dm, mode="drop")
+
+    fill_state = jnp.where(rd_s, jnp.where(d_u, EXC, SHD), MOD)
+    fill_val = jnp.where(rd_s, jnp.where(d_em, val_o, d1m), val_s)
+    cache_mat, cnts = _call_replay(
+        cfg, ca_t, cv_t, cs_t, idx2, cnt2, first_lose[None, :],
+        fill_state, fill_val)
+    ca_c, cv_c, cs_c = (cache_mat[:C], cache_mat[C:2 * C],
+                        cache_mat[2 * C:])
+    n_retired = cnts[0]
+
+    line_e = jnp.clip(ca_c, 0, E - 1)
+    line_dm = dm[line_e]
+    fresh = (line_dm[..., DM_ACT] >> 2) == st.round
+    a_code = jnp.where(fresh, line_dm[..., DM_ACT] & 3, ACT_NONE)
+    a_req = line_dm[..., DM_REQ]
+    valid = cs_c != INV
+    not_self = a_req != rows0[None, :]
+    kill = valid & not_self & (a_code == ACT_KILL)
+    down = valid & not_self & (a_code == ACT_DOWNGRADE)
+    promo = valid & not_self & (a_code == ACT_PROMOTE)
+    cs_c = jnp.where(kill, INV,
+                     jnp.where(down, SHD, jnp.where(promo, EXC, cs_c)))
+    dm = dm.at[jnp.where(promo, line_e, E).reshape(-1), DM_OWNER].set(
+        jnp.broadcast_to(rows0[None, :], (C, N)).reshape(-1),
+        mode="drop")
+
+    mt = st.metrics
+    metrics = mt.replace(
+        rounds=mt.rounds + 1,
+        instrs_retired=mt.instrs_retired + jnp.sum(n_retired))
+    new_st = st.replace(cache_addr=ca_c.T, cache_val=cv_c.T,
+                        cache_state=cs_c.T, dm=dm,
+                        idx=st.idx + n_retired, round=st.round + 1,
+                        metrics=metrics)
+    return new_st, claim
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2, 3))
+def run_split(cfg, st, chunk, max_rounds):
+    E = cfg.num_nodes << cfg.block_bits
+    claim0 = jnp.full((E,), jnp.iinfo(jnp.int32).max, jnp.int32)
+
+    def body(carry, _):
+        s, c = carry
+        return round_split(cfg, s, c), None
+
+    def cond(carry):
+        s, _ = carry
+        return (~s.quiescent()) & (s.round < max_rounds)
+
+    def chunk_body(carry):
+        carry, _ = jax.lax.scan(body, carry, None, length=chunk)
+        return carry
+
+    final, _ = jax.lax.while_loop(cond, chunk_body, (st, claim0))
+    return final
+
+
+def main():
+    cfg = SystemConfig.scale(num_nodes=4096, drain_depth=4, txn_width=3,
+                             pallas_burst=True)
+    cfg = dataclasses.replace(cfg, procedural="uniform", max_instrs=1)
+    st = se.procedural_state(cfg, 4096)
+
+    r = se.run_sync_to_quiescence(cfg, st, 64, 100000)
+    base_ret = int(np.asarray(r.metrics.instrs_retired))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        r = se.run_sync_to_quiescence(cfg, st, 64, 100000)
+        int(np.asarray(r.metrics.instrs_retired))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    print(f"baseline (claim in table): {base_ret/ts[1]:.3e} instrs/sec")
+
+    f = run_split(cfg, st, 64, 100000)
+    split_ret = int(np.asarray(f.metrics.instrs_retired))
+    assert split_ret == base_ret, (split_ret, base_ret)
+    np.testing.assert_array_equal(np.asarray(f.cache_val),
+                                  np.asarray(r.cache_val))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        f = run_split(cfg, st, 64, 100000)
+        int(np.asarray(f.metrics.instrs_retired))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    print(f"split dense claim array:   {split_ret/ts[1]:.3e} instrs/sec")
+
+
+if __name__ == "__main__":
+    main()
