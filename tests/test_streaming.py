@@ -162,3 +162,37 @@ def test_checkpoint_stream_shard_composition(tmp_path):
                                   np.asarray(ref.dm[:, :4]))
     assert (int(final.metrics.instrs_retired)
             == int(ref.metrics.instrs_retired) == 8 * 32)
+
+
+def test_multi_txn_chained_phases_equal_one_run():
+    """Phase streaming under multi-transaction windows: chained phases
+    must land exactly where one long run lands (local traffic), and
+    the phase boundary must reset the claim/action columns correctly
+    for the window machinery."""
+    cfg = SystemConfig.reference(num_nodes=4, max_instrs=16, txn_width=3)
+    rng = np.random.default_rng(29)
+    p1 = local_traces(rng, cfg, 16)
+    p2 = local_traces(rng, cfg, 16)
+
+    st = se.from_sim_state(cfg, init_state(cfg, p1))
+    st = se.run_sync_to_quiescence(cfg, st, 8, 20_000)
+    st = se.continue_with_traces(cfg, st, traces=p2)
+    st = se.run_sync_to_quiescence(cfg, st, 8, 20_000)
+    assert bool(st.quiescent())
+    se.check_exact_directory(cfg, st)
+    assert int(st.metrics.instrs_retired) == 4 * 32
+
+    cfg_long = SystemConfig.reference(num_nodes=4, max_instrs=32,
+                                      txn_width=3)
+    concat = [a + b for a, b in zip(p1, p2)]
+    ref = se.run_sync_to_quiescence(
+        cfg_long,
+        se.from_sim_state(cfg_long, init_state(cfg_long, concat)),
+        8, 20_000)
+    for f in ("cache_addr", "cache_val", "cache_state"):
+        np.testing.assert_array_equal(np.asarray(getattr(st, f)),
+                                      np.asarray(getattr(ref, f)), f)
+    mem_a, ds_a, _ = se.to_sim_arrays(cfg, st)
+    mem_b, ds_b, _ = se.to_sim_arrays(cfg_long, ref)
+    np.testing.assert_array_equal(mem_a, mem_b)
+    np.testing.assert_array_equal(ds_a, ds_b)
