@@ -1,0 +1,53 @@
+"""bench.py driver contract: exactly ONE JSON line on stdout.
+
+The driver records bench.py's stdout as the round's benchmark result,
+so the schema (metric/value/unit/vs_baseline) and the one-line
+guarantee are load-bearing across every engine mode; extras must go to
+stderr. Smoke configs on CPU keep this fast.
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+
+def run_bench(*args):
+    res = subprocess.run(
+        [sys.executable, "bench.py", "--smoke", *args],
+        capture_output=True, text=True, timeout=600, cwd=".")
+    assert res.returncode == 0, res.stderr[-2000:]
+    return res.stdout, res.stderr
+
+
+@pytest.mark.parametrize("args", [
+    (),                                        # sync + procedural
+    ("--engine", "async",),
+    ("--no-procedural",),
+    ("--replicas", "2", "--no-procedural"),
+    ("--txn-width", "1",),
+])
+def test_single_json_line_on_stdout(args):
+    out, err = run_bench(*args)
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be one JSON line, got: {out!r}"
+    rec = json.loads(lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert rec["unit"] == "instrs/sec"
+    assert rec["value"] > 0
+    # vs_baseline is rounded to 4 decimals in the report
+    assert rec["vs_baseline"] == pytest.approx(rec["value"] / 1e8,
+                                               abs=5e-5)
+    extras = json.loads(err.strip().splitlines()[-1])
+    assert extras["quiescent"] is True
+    assert extras["retired"] > 0
+
+
+def test_bad_flag_combinations_fail_loudly():
+    res = subprocess.run(
+        [sys.executable, "bench.py", "--smoke", "--engine", "async",
+         "--txn-width", "4"],
+        capture_output=True, text=True, timeout=120, cwd=".")
+    assert res.returncode == 2
+    assert "--engine sync" in res.stderr
