@@ -120,6 +120,16 @@ WINDOW_CASES = {
     "window_chain_race": [
         [(1, 0x20, 1), (1, 0x24, 2), (0, 0x20, 0), (1, 0x20, 3)],
         [(0, 0x24, 0), (1, 0x20, 8)], [], []],
+    # shared-line eviction (last-sharer promotion of node 1) racing
+    # node 1's own upgrade of the same block
+    "window_promote_vs_upgrade": [
+        [(0, 0x20, 0), (1, 0x24, 4)],
+        [(0, 0x20, 0), (1, 0x20, 6)], [], []],
+    # both nodes run fill-then-displace windows over the same two
+    # conflicting blocks in opposite orders
+    "window_crossed_releases": [
+        [(1, 0x20, 1), (1, 0x24, 2)],
+        [(1, 0x24, 3), (1, 0x20, 4)], [], []],
 }
 
 
