@@ -1,0 +1,124 @@
+"""Calibrate in-kernel loop economics on the attached TPU.
+
+Round-2 throughput design hinges on how a `fori_loop` *inside* one
+jitted Pallas kernel prices per-iteration work, versus dispatching one
+kernel per round from a jitted scan (PERF.md's ~100-700 us/kernel).
+PERF.md's earlier 25-100 us/backedge figure came from eager standalone
+launches (scripts/prof_inkernel*.py); this script re-measures under the
+real conditions: kernels embedded in jit, synced via device_get.
+
+Measures:
+  A. jitted pallas_call, in-kernel fori_loop(R) with a small vector body
+     on a [8, 1024] block — cost vs R isolates the backedge.
+  B. same, nested fori (outer R, inner 64) — do nested backedges pay?
+  C. jitted lax.scan of R pallas_calls (1 kernel/iter) — the dispatch
+     alternative.
+  D. in-kernel fori over a body with ~32 vector ops (a round-fold-sized
+     body) — per-op cost inside a loop.
+"""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+def sync(x):
+    return float(np.asarray(jax.device_get(x)).ravel()[0])
+
+
+def timeit(fn, *args, reps=3):
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sync(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def kern_fori(R, x_ref, o_ref):
+    def body(i, acc):
+        return acc * jnp.int32(3) + jnp.int32(1) ^ (acc >> 7)
+    o_ref[...] = jax.lax.fori_loop(0, R, body, x_ref[...])
+
+
+def kern_nested(R, inner, x_ref, o_ref):
+    def ibody(j, acc):
+        return acc * jnp.int32(3) + jnp.int32(1) ^ (acc >> 7)
+
+    def body(i, acc):
+        return jax.lax.fori_loop(0, inner, ibody, acc)
+    o_ref[...] = jax.lax.fori_loop(0, R, body, x_ref[...])
+
+
+def kern_fat(R, x_ref, o_ref):
+    def body(i, acc):
+        for _ in range(16):  # ~32 vector ops
+            acc = acc * jnp.int32(3) + jnp.int32(1)
+            acc = acc ^ (acc >> 7)
+        return acc
+    o_ref[...] = jax.lax.fori_loop(0, R, body, x_ref[...])
+
+
+def pcall(kern, R, *extra):
+    shape = jax.ShapeDtypeStruct((8, 1024), jnp.int32)
+
+    @jax.jit
+    def run(x):
+        return pl.pallas_call(functools.partial(kern, R, *extra),
+                              out_shape=shape)(x)
+    return run
+
+
+def main():
+    x = jnp.arange(8 * 1024, dtype=jnp.int32).reshape(8, 1024)
+    print("backend:", jax.default_backend())
+
+    print("\nA. in-kernel fori, trivial body")
+    prev = None
+    for R in (64, 256, 1024, 4096):
+        t = timeit(pcall(kern_fori, R), x)
+        d = "" if prev is None else f"  marginal/iter: {(t - prev[1]) / (R - prev[0]) * 1e6:.2f} us"
+        print(f"  R={R:5d}: {t*1e3:8.2f} ms{d}")
+        prev = (R, t)
+
+    print("\nB. nested fori, outer x inner=64, trivial body")
+    for R in (64, 256):
+        t = timeit(pcall(kern_nested, R, 64), x)
+        print(f"  R={R:5d} (total {R*64}): {t*1e3:8.2f} ms "
+              f"({t / (R*64) * 1e6:.2f} us/total-iter)")
+
+    print("\nC. jitted scan of R pallas_calls (dispatch alternative)")
+    shape = jax.ShapeDtypeStruct((8, 1024), jnp.int32)
+
+    def one(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * jnp.int32(3) + jnp.int32(1) ^ (x_ref[...] >> 7)
+
+    @functools.partial(jax.jit, static_argnums=1)
+    def scan_calls(x, R):
+        def body(c, _):
+            return pl.pallas_call(one, out_shape=shape)(c), None
+        out, _ = jax.lax.scan(body, x, None, length=R)
+        return out
+    prev = None
+    for R in (16, 64, 256):
+        t = timeit(scan_calls, x, R)
+        d = "" if prev is None else f"  marginal/call: {(t - prev[1]) / (R - prev[0]) * 1e6:.1f} us"
+        print(f"  R={R:5d}: {t*1e3:8.2f} ms{d}")
+        prev = (R, t)
+
+    print("\nD. in-kernel fori, ~32-op body")
+    prev = None
+    for R in (64, 256, 1024):
+        t = timeit(pcall(kern_fat, R), x)
+        d = "" if prev is None else f"  marginal/iter: {(t - prev[1]) / (R - prev[0]) * 1e6:.2f} us"
+        print(f"  R={R:5d}: {t*1e3:8.2f} ms{d}")
+        prev = (R, t)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,168 @@
+"""Calibration part 2: marginal kernel dispatch in long scans, dynamic
+row addressing inside Pallas, and MXU one-hot gather.
+
+E. scan-of-pallas-kernels at large R: marginal us/kernel (clean).
+F. scan of XLA fused elementwise step at large R: marginal us/step.
+G. in-kernel fori doing a *dynamic row* load+store on a [4096, 128]
+   ref per iteration (the scalar-serialization primitive).
+H. in-kernel blocked one-hot MXU gather: 4096 rows from [4096, 8pad128]
+   vs the XLA gather of the same.
+I. XLA scatter-min + gather pair at deep-window index sizes.
+"""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+def sync(x):
+    return float(np.asarray(jax.device_get(x)).ravel()[0])
+
+
+def timeit(fn, *args, reps=5):
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sync(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def marginal(fn, Rs, label):
+    prev = None
+    for R in Rs:
+        t = timeit(fn, R) if not isinstance(R, tuple) else timeit(fn, *R)
+        r = R if not isinstance(R, tuple) else R[0]
+        d = "" if prev is None else (
+            f"  marginal: {(t - prev[1]) / (r - prev[0]) * 1e6:.1f} us/iter")
+        print(f"  {label} R={r:6d}: {t*1e3:8.2f} ms{d}")
+        prev = (r, t)
+
+
+shape = jax.ShapeDtypeStruct((8, 1024), jnp.int32)
+
+
+def one(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * jnp.int32(3) + jnp.int32(1) ^ (x_ref[...] >> 7)
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def scan_pallas(x, R):
+    def body(c, _):
+        return pl.pallas_call(one, out_shape=shape)(c), None
+    out, _ = jax.lax.scan(body, x, None, length=R)
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def scan_xla(x, R):
+    def body(c, _):
+        return c * jnp.int32(3) + jnp.int32(1) ^ (c >> 7), None
+    out, _ = jax.lax.scan(body, x, None, length=R)
+    return out
+
+
+def kern_dynrow(R, x_ref, o_ref):
+    def body(i, acc):
+        r = (i * jnp.int32(-1640531527)) % jnp.int32(4096)
+        row = x_ref[pl.ds(r, 1), :]
+        o_ref[pl.ds(r, 1), :] = row + acc
+        return acc + jnp.int32(1)
+    acc = jax.lax.fori_loop(0, R, body, jnp.int32(0))
+    o_ref[pl.ds(0, 1), :] = o_ref[pl.ds(0, 1), :] + acc
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def dynrow(x, R):
+    return pl.pallas_call(functools.partial(kern_dynrow, R),
+                          out_shape=jax.ShapeDtypeStruct((4096, 128),
+                                                         jnp.int32),
+                          input_output_aliases={0: 0})(x)
+
+
+BLK = 512
+
+
+def kern_onehot(x_ref, idx_ref, o_ref):
+    # gather rows idx[j] (j in [0,4096)) from x [4096, 128] via blocked
+    # one-hot matmul on the MXU
+    idx = idx_ref[...]                                   # [8, 512] int32
+    idxf = idx.reshape(4096)
+    acc = jnp.zeros((4096, 128), jnp.float32)
+    for b in range(4096 // BLK):
+        oh = (idxf[:, None] == (jax.lax.broadcasted_iota(
+            jnp.int32, (4096, BLK), 1) + b * BLK)).astype(jnp.float32)
+        acc += jax.lax.dot(oh, x_ref[pl.ds(b * BLK, BLK), :].astype(
+            jnp.float32), precision=jax.lax.Precision.HIGHEST)
+    o_ref[...] = acc.astype(jnp.int32)
+
+
+@jax.jit
+def onehot_gather(x, idx):
+    return pl.pallas_call(kern_onehot,
+                          out_shape=jax.ShapeDtypeStruct((4096, 128),
+                                                         jnp.int32))(x, idx)
+
+
+@functools.partial(jax.jit, static_argnums=2)
+def xla_gather_scan(x, idx, R):
+    def body(c, _):
+        g = x[c]                                        # [4096, 128] gather
+        c2 = (c + g[:, 0]) % jnp.int32(4096)
+        return c2, None
+    out, _ = jax.lax.scan(body, idx.reshape(4096), None, length=R)
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def xla_scatter_gather_scan(dm, idx, R, n_idx):
+    # deep-window-sized claim scatter-min + row gather per iteration
+    def body(c, _):
+        dmc = dm.at[c[:n_idx], 6].min(c[:n_idx])
+        rows = dmc[c[:n_idx] % jnp.int32(65536)]
+        c2 = (c + rows[: c.shape[0], 1].sum()) % jnp.int32(65536)
+        return c2, None
+    out, _ = jax.lax.scan(body, idx, None, length=R)
+    return out
+
+
+def main():
+    print("backend:", jax.default_backend())
+    x = jnp.arange(8 * 1024, dtype=jnp.int32).reshape(8, 1024)
+    print("\nE. scan of pallas kernels")
+    marginal(functools.partial(scan_pallas, x), (256, 1024, 2048), "pallas")
+    print("\nF. scan of XLA fused step")
+    marginal(functools.partial(scan_xla, x), (256, 1024, 2048), "xla   ")
+
+    print("\nG. in-kernel dynamic row load+store")
+    xg = jnp.arange(4096 * 128, dtype=jnp.int32).reshape(4096, 128)
+    for R in (1024, 4096, 16384):
+        t = timeit(dynrow, xg, R)
+        print(f"  R={R:6d}: {t*1e3:8.2f} ms  ({t/R*1e6:.2f} us/row incl fixed)")
+
+    print("\nH. scan of XLA gathers (marginal = true per-gather)")
+    idx = ((jnp.arange(4096, dtype=jnp.int32) * jnp.int32(-1640531527)) % 4096)
+    marginal(functools.partial(xla_gather_scan, xg, idx.reshape(8, 512)),
+             (64, 256, 512), "gather")
+
+    print("\nI. scan of XLA scatter-min+gather at [98k idx] on [65536,7]")
+    dm = jnp.zeros((65536, 7), jnp.int32) + jnp.int32(2**30)
+    idx = ((jnp.arange(98304, dtype=jnp.int32) * jnp.int32(-1640531527)) % 65536)
+    for n_idx in (24576, 98304):
+        f = functools.partial(xla_scatter_gather_scan, dm, idx)
+        prev = None
+        for R in (64, 256):
+            t = timeit(lambda R=R: f(R, n_idx))
+            if prev is not None:
+                print(f"  n_idx={n_idx}: marginal "
+                      f"{(t - prev[1]) / (R - prev[0]) * 1e6:.1f} us/iter")
+            prev = (R, t)
+
+
+if __name__ == "__main__":
+    main()

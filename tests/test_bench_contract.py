@@ -7,16 +7,19 @@ stderr. Smoke configs on CPU keep this fast.
 """
 
 import json
+import os
 import subprocess
 import sys
 
 import pytest
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def run_bench(*args):
     res = subprocess.run(
         [sys.executable, "bench.py", "--smoke", *args],
-        capture_output=True, text=True, timeout=600, cwd=".")
+        capture_output=True, text=True, timeout=600, cwd=REPO_ROOT)
     assert res.returncode == 0, res.stderr[-2000:]
     return res.stdout, res.stderr
 
@@ -48,6 +51,6 @@ def test_bad_flag_combinations_fail_loudly():
     res = subprocess.run(
         [sys.executable, "bench.py", "--smoke", "--engine", "async",
          "--txn-width", "4"],
-        capture_output=True, text=True, timeout=120, cwd=".")
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT)
     assert res.returncode == 2
     assert "--engine sync" in res.stderr

@@ -46,20 +46,36 @@ def reference_binary(tmp_path_factory):
     return build, exe
 
 
-def run_reference(build, exe, suite, grace=1.0):
-    """Run-until-killed, as the reference harness does (test3.sh)."""
-    for n in range(4):
-        out = build / f"core_{n}_output.txt"
+def run_reference(build, exe, suite, grace=1.0, deadline=10.0):
+    """Run-until-killed, as the reference harness does (test3.sh).
+
+    The harness sleeps a fixed second before the SIGKILL; on a loaded
+    host the OpenMP threads may still be writing the four output files
+    at that point, so instead of trusting one fixed grace period we
+    poll until all four files exist with stable sizes (or a hard
+    deadline passes), then kill."""
+    outs = [build / f"core_{n}_output.txt" for n in range(4)]
+    for out in outs:
         if out.exists():
             out.unlink()
     proc = subprocess.Popen([str(exe), suite], cwd=build,
                             stdout=subprocess.DEVNULL,
                             stderr=subprocess.DEVNULL)
     time.sleep(grace)
+    t0, last = time.monotonic(), None
+    while time.monotonic() - t0 < deadline:
+        sizes = [out.stat().st_size if out.exists() else -1
+                 for out in outs]
+        if min(sizes) >= 0 and sizes == last:
+            break
+        last = sizes
+        time.sleep(0.1)
     proc.send_signal(signal.SIGKILL)
     proc.wait()
-    return {n: (build / f"core_{n}_output.txt").read_text()
-            for n in range(4)}
+    missing = [out.name for out in outs if not out.exists()]
+    assert not missing, (
+        f"reference binary produced no {missing} within {deadline}s")
+    return {n: outs[n].read_text() for n in range(4)}
 
 
 @pytest.mark.parametrize("suite", ["sample", "test_1", "test_2"])

@@ -9,6 +9,7 @@ CLI's `--engine native` path.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -17,7 +18,13 @@ from typing import Optional, Sequence
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_LIB_PATH = os.path.join(_DIR, "libcoherence_native.so")
+_SRC = os.path.join(_DIR, "engine.cpp")
+_CXX = os.environ.get("CXX", "g++")
+# CXXFLAGS env overrides, as the Makefile's `CXXFLAGS ?=` did; the flag
+# string participates in the cache key, so sanitizer/debug builds get
+# their own cached library instead of silently reusing the default one
+_CXXFLAGS = os.environ.get(
+    "CXXFLAGS", "-O2 -std=c++17 -fPIC -Wall -Wextra").split()
 _lock = threading.Lock()
 _lib = None
 
@@ -26,8 +33,28 @@ _METRIC_NAMES = ("cycles", "instrs_retired", "read_hits", "write_hits",
                  "invalidations", "evictions")
 
 
-def _build() -> None:
-    subprocess.run(["make", "-s", "-C", _DIR], check=True)
+def _lib_path() -> str:
+    """Build-cache path keyed on the source + compiler command hash.
+
+    No binary is checked in (and mtime comparisons lie after a fresh
+    clone, where checkout order decides which file is newer): the
+    library is compiled on first use into ``build/`` under a name that
+    embeds a content hash, so a source or flag change can never pick up
+    a stale binary, and repeat imports reuse the cached build."""
+    h = hashlib.sha256()
+    with open(_SRC, "rb") as f:
+        h.update(f.read())
+    h.update(" ".join([_CXX] + _CXXFLAGS).encode())
+    return os.path.join(_DIR, "build",
+                        f"libcoherence_native-{h.hexdigest()[:16]}.so")
+
+
+def _build(lib_path: str) -> None:
+    os.makedirs(os.path.dirname(lib_path), exist_ok=True)
+    tmp = lib_path + f".tmp{os.getpid()}"
+    subprocess.run([_CXX] + _CXXFLAGS + ["-shared", "-o", tmp, _SRC],
+                   check=True)
+    os.replace(tmp, lib_path)   # atomic: concurrent builders both win
 
 
 def load_library() -> ctypes.CDLL:
@@ -35,11 +62,10 @@ def load_library() -> ctypes.CDLL:
     with _lock:
         if _lib is not None:
             return _lib
-        src = os.path.join(_DIR, "engine.cpp")
-        if (not os.path.exists(_LIB_PATH)
-                or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)):
-            _build()
-        lib = ctypes.CDLL(_LIB_PATH)
+        lib_path = _lib_path()
+        if not os.path.exists(lib_path):
+            _build(lib_path)
+        lib = ctypes.CDLL(lib_path)
         i32p = ctypes.POINTER(ctypes.c_int32)
         u32p = ctypes.POINTER(ctypes.c_uint32)
         i64p = ctypes.POINTER(ctypes.c_int64)

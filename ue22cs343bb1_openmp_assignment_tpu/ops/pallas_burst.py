@@ -124,6 +124,14 @@ def _tile(N: int) -> int:
     return T
 
 
+def tileable(N: int) -> bool:
+    """True when the node axis fits the kernels' tiling (<=1024 or a
+    multiple of 1024). sync_engine.round_step silently keeps the
+    bit-identical XLA path for untileable N instead of raising from
+    inside the kernel call."""
+    return N <= 1024 or N % 1024 == 0
+
+
 def _interpret() -> bool:
     """Auto-select the Pallas interpreter off-TPU (the CPU test path)."""
     return jax.default_backend() != "tpu"

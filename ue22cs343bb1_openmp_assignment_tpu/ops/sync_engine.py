@@ -371,6 +371,17 @@ def _round_key(cfg: SystemConfig, st: SyncState, rows: jnp.ndarray):
     x ^= x >> max(1, prio_bits // 2)
     x = (x * jnp.uint32(0x9E3779B9 | 1)) & mask
     prio = x.astype(jnp.int32)
+    # The clamp keeps overrun (round > claim_max_rounds) free of int32
+    # wraparound, at two costs beyond the documented stale-claim stalls:
+    # every overrun round shares countdown 0, so (a) claims from
+    # *earlier* overrun rounds look fresh to the interior-hit safety
+    # probe (`thresh` in _round_step_multi), spuriously truncating
+    # windows, and (b) the same node always beats the same rivals (the
+    # per-round reshuffle is gone), so fairness degrades. Progress is
+    # still guaranteed, only slower. The public runners keep this regime
+    # unreachable by asserting the budget up front
+    # (_assert_round_budget); only direct round_step callers can enter
+    # it.
     countdown = jnp.maximum(claim_max_rounds(cfg) - st.round, 0)
     return (countdown << prio_bits) | prio
 
@@ -386,9 +397,15 @@ def round_step(cfg: SystemConfig, st: SyncState,
     dispatch. cfg.pallas_burst routes the window fold through fused
     Pallas kernels on procedural workloads (ops.pallas_burst /
     ops.pallas_window), bit-identically."""
-    if cfg.txn_width == 1:
-        return _round_step_single(cfg, st, with_events)
     if cfg.pallas_burst and cfg.procedural and not with_events:
+        from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_burst
+        use_pallas = pallas_burst.tileable(cfg.num_nodes)
+    else:
+        use_pallas = False
+    if cfg.txn_width == 1:
+        return _round_step_single(cfg, st, with_events,
+                                  use_pallas=use_pallas)
+    if use_pallas:
         from ue22cs343bb1_openmp_assignment_tpu.ops.pallas_window import (
             round_step_multi_pallas)
         return round_step_multi_pallas(cfg, st)
@@ -396,7 +413,8 @@ def round_step(cfg: SystemConfig, st: SyncState,
 
 
 def _round_step_single(cfg: SystemConfig, st: SyncState,
-                       with_events: bool = False):
+                       with_events: bool = False,
+                       use_pallas: bool | None = None):
     """Advance every node by one burst of hits plus one transaction.
 
     ``with_events=True`` additionally returns this round's retirement
@@ -416,7 +434,12 @@ def _round_step_single(cfg: SystemConfig, st: SyncState,
     idx0 = st.idx
 
     c_iota = jnp.arange(C, dtype=jnp.int32)
-    if cfg.pallas_burst and cfg.procedural and not with_events:
+    if use_pallas is None:
+        from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_burst
+        use_pallas = (cfg.pallas_burst and cfg.procedural
+                      and not with_events
+                      and pallas_burst.tileable(cfg.num_nodes))
+    if use_pallas:
         # ---- phases 1-2a as ONE fused Pallas kernel (ops.pallas_burst;
         # flag-gated — see that module's docstring for the economics)
         from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_burst
