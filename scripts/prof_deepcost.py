@@ -1,0 +1,106 @@
+"""Pre-build probes for the deep-window engine.
+
+J. Does a drop-mode scatter/gather pay for PADDED (out-of-range)
+   indices? Compares all-real vs 75%-padded at equal slot counts.
+K. Fold-sized Pallas kernel cost: ~W*170 vector ops on [1,1024] rows,
+   embedded in a scan — marginal per call.
+"""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+def sync(x):
+    return float(np.asarray(jax.device_get(x)).ravel()[0])
+
+
+def timeit(fn, *args, reps=5):
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sync(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def marg(f, Rs=(64, 256)):
+    t1 = timeit(f, Rs[0])
+    t2 = timeit(f, Rs[1])
+    return (t2 - t1) / (Rs[1] - Rs[0]) * 1e6
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def scat_gath(dm, idx, R):
+    E = dm.shape[0]
+
+    def body(c, _):
+        dmc = dm.at[c, 6].min(c)
+        rows = dmc[jnp.where(c < E, c, 0)]
+        c2 = (c + rows[:, 1]) % jnp.int32(E + E // 4)
+        return c2, None
+    out, _ = jax.lax.scan(body, idx, None, length=R)
+    return out
+
+
+def kern_fold(W, x_ref, o_ref):
+    rows = [x_ref[i:i + 1, :] for i in range(16)]
+    acc = x_ref[0:1, :]
+    for k in range(W):
+        b = (acc & jnp.int32(15))
+        sel = rows[0]
+        for c in range(1, 16):
+            sel = jnp.where(b == c, rows[c], sel)      # 16-way own-row read
+        for _ in range(24):                            # misc fold arithmetic
+            acc = (acc * jnp.int32(3) + sel) ^ (acc >> 7)
+        nb = acc & jnp.int32(15)
+        rows = [jnp.where(nb == c, acc, r) for c, r in enumerate(rows)]
+    o_ref[...] = jnp.concatenate(
+        [r + (acc & jnp.int32(0)) for r in rows], axis=0)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def scan_fold(x, W, R):
+    shape = jax.ShapeDtypeStruct((16, 1024), jnp.int32)
+
+    def body(c, _):
+        o = pl.pallas_call(functools.partial(kern_fold, W),
+                           out_shape=shape,
+                           grid=(4,),
+                           in_specs=[pl.BlockSpec((16, 1024),
+                                                  lambda i: (0, i))],
+                           out_specs=pl.BlockSpec((16, 1024),
+                                                  lambda i: (0, i)))(c)
+        return o, None
+    out, _ = jax.lax.scan(body, x, None, length=R)
+    return out
+
+
+def main():
+    print("backend:", jax.default_backend())
+    E = 65536
+    dm = jnp.full((E, 7), 2**30, jnp.int32)
+    n = 57344                       # 14 slots x 4096 nodes
+    base = ((jnp.arange(n, dtype=jnp.int32) * jnp.int32(-1640531527))
+            % E)
+    print("J. scatter+gather pair, 57K slots")
+    for frac_real, name in ((1.0, "all real"), (0.25, "75% padded")):
+        k = int(n * frac_real)
+        idx = jnp.where(jnp.arange(n) < k, base, E)   # E = dropped
+        m = marg(functools.partial(scat_gath, dm, idx))
+        print(f"  {name}: marginal {m:.1f} us/iter")
+
+    print("K. fold-sized pallas kernel (4 tiles of [16,1024])")
+    x = jnp.arange(16 * 1024, dtype=jnp.int32).reshape(16, 1024) & 0xFF
+    for W in (8, 24):
+        m = marg(functools.partial(scan_fold, x, W))
+        print(f"  W={W}: marginal {m:.1f} us/call")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,130 @@
+"""Deep-window engine (ops/deep_engine): invariants, progress, parity.
+
+The deep engine commits arbitrarily deep own-entry transaction chains
+plus absorbed remote events per round. Its correctness net here:
+
+* **Exact directory every round** — the transactional engines' core
+  invariant (sync_engine.check_exact_directory), checked after every
+  single round across contended workloads, seeds, and slot budgets.
+  This is the strongest machine-checkable statement that each round is
+  a legal serialization (the reference's -DDEBUG asserts, upgraded).
+* **Progress** — every configuration drains to quiescence with all
+  instructions retired (the priority symmetry-breaking argument in the
+  module docstring; regression net for the ghost-event deadlocks found
+  during development: attempt-based marks, crossed evict/fill pairs).
+* **Local-workload parity** — on node-local (schedule-independent)
+  workloads every legal schedule produces the same final state, so the
+  deep engine must agree bit-for-bit with the single-transaction
+  engine.
+* **Golden parity** — reference suites test_1/test_2 are node-local,
+  so the deep engine must reproduce their golden dumps byte-exactly.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+
+from tests.conftest import REFERENCE_TESTS, requires_reference
+
+
+def deep_cfg(N, lf, seed=0, dd=4, tw=4, Q=6, G=3):
+    cfg = SystemConfig.scale(N, drain_depth=dd, txn_width=tw)
+    return dataclasses.replace(
+        cfg, procedural="uniform", max_instrs=1,
+        proc_local_permille=lf, proc_seed=seed,
+        deep_window=True, deep_slots=Q, deep_ownerval_slots=G)
+
+
+def drain_checked(cfg, length=48, max_rounds=4000, check_every=1):
+    st = se.procedural_state(cfg, length)
+    step = jax.jit(lambda s: se.round_step(cfg, s))
+    rounds = 0
+    while not bool(st.quiescent()) and rounds < max_rounds:
+        st = step(st)
+        rounds += 1
+        if rounds % check_every == 0:
+            se.check_exact_directory(cfg, st)
+    assert bool(st.quiescent()), (
+        f"no quiescence after {max_rounds} rounds; idx="
+        f"{np.asarray(st.idx)}")
+    se.check_exact_directory(cfg, st)
+    assert int(st.metrics.instrs_retired) == cfg.num_nodes * length
+    return st, rounds
+
+
+@pytest.mark.parametrize("lf", [0, 200, 500, 800])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_contended_invariants_and_progress(lf, seed):
+    cfg = deep_cfg(4, lf, seed=seed)
+    drain_checked(cfg, length=48)
+
+
+@pytest.mark.parametrize("N,lf,dd,tw,Q,G", [
+    (8, 500, 4, 4, 6, 3),
+    (16, 300, 8, 8, 8, 4),     # crossed evict/fill regression regime
+    (16, 800, 12, 4, 8, 4),
+    (32, 500, 6, 2, 4, 2),     # tight slot budgets
+    (8, 100, 2, 1, 3, 1),      # near-degenerate window
+])
+def test_parameter_sweep(N, lf, dd, tw, Q, G):
+    cfg = deep_cfg(N, lf, dd=dd, tw=tw, Q=Q, G=G)
+    drain_checked(cfg, length=48, check_every=2)
+
+
+def test_local_only_parity_with_single_engine():
+    """All-local workloads are schedule-independent: the deep engine
+    must match the single-transaction engine's final state exactly."""
+    base = SystemConfig.scale(16, drain_depth=6, txn_width=4)
+    base = dataclasses.replace(base, procedural="uniform", max_instrs=1,
+                               proc_local_permille=1000)
+    deep = dataclasses.replace(base, deep_window=True)
+    out_d = se.run_sync_to_quiescence(deep, se.procedural_state(deep, 64),
+                                      chunk=8, max_rounds=4000)
+    out_s = se.run_sync_to_quiescence(base, se.procedural_state(base, 64),
+                                      chunk=8, max_rounds=4000)
+    se.check_exact_directory(deep, out_d)
+    for f in ("cache_addr", "cache_val", "cache_state"):
+        np.testing.assert_array_equal(np.asarray(getattr(out_d, f)),
+                                      np.asarray(getattr(out_s, f)), f)
+    dm_d, dm_s = np.asarray(out_d.dm), np.asarray(out_s.dm)
+    np.testing.assert_array_equal(dm_d[:, 0], dm_s[:, 0], "dir state")
+    np.testing.assert_array_equal(dm_d[:, 3], dm_s[:, 3], "memory")
+    # deep windows must actually be deep: fewer rounds than single-txn
+    assert int(out_d.metrics.rounds) < int(out_s.metrics.rounds)
+
+
+def test_runner_integration_and_budget():
+    """run_sync_to_quiescence dispatches deep rounds and asserts the
+    halved claim budget (the lane spends one key bit on the ev tag)."""
+    cfg = deep_cfg(8, 700)
+    nb = max(1, (cfg.num_nodes - 1).bit_length())
+    assert se.claim_max_rounds(cfg) == (1 << (30 - nb - 1)) - 1
+    out = se.run_sync_to_quiescence(cfg, se.procedural_state(cfg, 32),
+                                    chunk=8, max_rounds=4000)
+    assert bool(out.quiescent())
+
+
+@requires_reference
+@pytest.mark.parametrize("suite", ["test_1", "test_2"])
+def test_golden_parity_deterministic_suites(suite, tmp_path):
+    """test_1/test_2 are node-local => deterministic; the deep engine
+    must reproduce the reference's golden dumps byte-for-byte."""
+    from ue22cs343bb1_openmp_assignment_tpu.models.transactional import (
+        TransactionalSystem)
+
+    cfg = dataclasses.replace(SystemConfig.reference(),
+                              deep_window=True, deep_slots=6,
+                              deep_ownerval_slots=3)
+    sys_ = TransactionalSystem.from_test_dir(
+        f"{REFERENCE_TESTS}/{suite}", cfg).run()
+    sys_.check_invariants()
+    dumps = sys_.dumps()
+    for n in range(4):
+        want = open(f"{REFERENCE_TESTS}/{suite}/core_{n}_output.txt",
+                    "rb").read().decode()
+        assert dumps[n] == want, f"{suite} core_{n} diverges"

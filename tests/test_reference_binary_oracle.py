@@ -62,14 +62,17 @@ def run_reference(build, exe, suite, grace=1.0, deadline=10.0):
                             stdout=subprocess.DEVNULL,
                             stderr=subprocess.DEVNULL)
     time.sleep(grace)
-    t0, last = time.monotonic(), None
+    t0, last, stable = time.monotonic(), None, 0
     while time.monotonic() - t0 < deadline:
         sizes = [out.stat().st_size if out.exists() else -1
                  for out in outs]
-        if min(sizes) >= 0 and sizes == last:
+        # require a quiet window much longer than one buffered-stdio
+        # flush gap, not just two identical samples
+        stable = stable + 1 if (min(sizes) >= 0 and sizes == last) else 0
+        if stable >= 3:
             break
         last = sizes
-        time.sleep(0.1)
+        time.sleep(0.25)
     proc.send_signal(signal.SIGKILL)
     proc.wait()
     missing = [out.name for out in outs if not out.exists()]

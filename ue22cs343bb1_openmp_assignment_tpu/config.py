@@ -69,6 +69,24 @@ class SystemConfig:
     # wider windows retire more instructions per dispatch.
     txn_width: int = 1
 
+    # Deep-window transactional engine (ops.deep_engine): per round each
+    # node composes arbitrarily deep transaction chains on its OWN
+    # directory entries (dense, gather-free — the dm table's row index
+    # is the address, so a node's own slice aligns with the node axis)
+    # and issues at most deep_slots remote events (fill requests /
+    # eviction notices), which serialize per-entry through a scatter-min
+    # lane and compose after the owning home's chain. Same protocol,
+    # far more retired instructions per round on locality-heavy
+    # workloads. The window length is drain_depth + txn_width, as for
+    # the multi-transaction engine.
+    deep_window: bool = False
+    # per-node per-round budget of remote events (requests, eviction
+    # notices, and remote-hit safety probes share these slots); overflow
+    # stops the window for that round
+    deep_slots: int = 8
+    # per-node per-round budget of own-entry EM-owner value resolutions
+    deep_ownerval_slots: int = 4
+
     # Procedural workload (sync engine): when set (e.g. "uniform"),
     # instructions are computed per (node, index) from a counter-based
     # hash inside the round instead of gathered from a stored [N, T]
@@ -106,6 +124,10 @@ class SystemConfig:
     def __post_init__(self):
         if self.num_nodes < 1:
             raise ValueError("num_nodes must be >= 1")
+        if self.deep_window and self.mem_size > (1 << 16):
+            raise ValueError(
+                "deep_window packs block indices in 16 bits; "
+                "mem_size must be <= 65536")
         if self.txn_width < 1:
             raise ValueError("txn_width must be >= 1")
         if self.inv_mode not in ("mailbox", "scatter"):

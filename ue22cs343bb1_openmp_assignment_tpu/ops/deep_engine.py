@@ -1,0 +1,754 @@
+"""Deep-window transactional engine: dense own-entry chains plus
+absorbed remote requests.
+
+Round 2's device calibration (scripts/prof_backedge*.py, PERF.md)
+overturned the round-1 cost model: per-kernel dispatch inside a
+compiled loop is ~free; the binding cost is **scatter/gather index
+count** (~5-6 us per 1K indices per pass). The multi-transaction window
+engine (ops/sync_engine._round_step_multi) pays gather/scatter indices
+for *every* transaction, and its window algebra truncates at the
+second touch of any directory entry, committing ~2.2 of a K=3 budget.
+
+This engine re-partitions the round by *locality*, exploiting the dm
+table layout (row index == packed address): reshaped ``[N, S, cols]``,
+node n's own directory entries ARE row n — **a node's transactions on
+its own entries need no gather, no scatter, and no claim**. The fold
+composes arbitrarily deep chains on own entries (fill -> evict ->
+refill -> upgrade -> ...) as pure dense arithmetic, and only *remote*
+touches (fill requests and eviction notices to other homes) pay
+indices. At the bench workload's 80% locality this retires most of a
+W-instruction window per node per round instead of ~2.2.
+
+Protocol semantics are the reference's 13-handler contract collapsed
+into atomic transactions, exactly as ops/sync_engine (SURVEY §3.2-3.5;
+``assignment.c:190-618`` is the message-level original): same MESI +
+EM/S/U directory transitions, same quirks where they are observable at
+transaction granularity (e.g. the UPGRADE handler's unconditional
+dir->EM{requester} regardless of directory state,
+``assignment.c:325-349`` — see the UP composition below).
+
+Round serialization argument (why every committed round is a legal
+serialization of the reference machine):
+
+1. **Phase H** — every node's pre-first-transaction hit prefix.
+   Node-local, serialized first (as in _round_step_multi).
+2. **Chain phase** — each node's committed window segment: hits and
+   own-entry transactions. Chains of two nodes touch disjoint
+   directory rows (own entries only), so any relative order works;
+   program order within each node is preserved by construction.
+   Mid-window hits on *own* entries are unconditionally safe: foreign
+   effects on an own entry can only arrive as requests, and requests
+   serialize after all chains. Mid-window hits on *remote* lines are
+   safe unless that entry's home chain-transacted on it this round —
+   detected via the home's dense **marker** flag (gathered per hit);
+   a fresh marker truncates the window at the hit (the home's kill or
+   downgrade may not admit a consistent order with our later reads).
+3. **Request phase** — remote fill requests (RD/WR/UP) and eviction
+   notices (EV_S/EV_M) compose *after* the chains, at most one per
+   entry per round (scatter-min lane on DM_CLAIM, priority-first: a
+   node that wins one of its events this round wins all of them, so
+   crossed evict/fill pairs cannot starve each other). A winning fill
+   request reads the post-chain row and writes the composed row back;
+   this absorbs the common collision (home chain + one foreign
+   request both commit in one round). Owner values are read from the
+   owner's **cv_req snapshot** (its cache as of its own first
+   fill-request attempt), which keeps every observed value inside the
+   owner's pre-request stratum. Conflicts between a home's chain and
+   foreign events on its entries are resolved by a **priority total
+   order** — the lower-priority side gives way, mutually
+   consistently, so the global-minimum-priority node always advances
+   (the progress guarantee):
+
+   * **marker vs notice** — a notice's evictor was a holder, so a
+     same-round chain touch of its entry always set the home's dense
+     *marker* flag. If the home's priority wins, the notice aborts;
+     otherwise the chain yields (truncates) at its touch and the
+     notice composes on the untouched row.
+   * **poison vs request** — a request must not observe chain ops the
+     home executed at or after the home's own first fill-request
+     attempt (else two windows can require each other's later
+     segments to precede their own earlier ones — an order cycle).
+     Such entries carry the home's dense *poison* flag: the
+     lower-priority side (request, or the home's post-request touch)
+     gives way.
+   * **pending rows compose, no abort** — a chain that evicts a
+     SHARED own line leaving one sharer promotes an owner it cannot
+     name (the engine is bitvector-free; the promoted line
+     self-reports in the fan-out) and records owner = -1. SHARED
+     lines are clean in this protocol (every downgrade/flush writes
+     memory), so the promoted line's value equals the row's memory —
+     requests and notices compose on pending rows using mem, with a
+     promote-then-X action override (read nets DOWNGRADE, write
+     KILLs, the promotee's own notice cancels).
+
+   Marker and poison are *fold outputs of the home*, dense over its
+   own slice — reshaping ``[N, S] -> [E]`` makes them gatherable with
+   zero scatters; they are attempt-based (conservative), costing only
+   retries, never soundness. A lost lane, losing-priority abort, or
+   unsafe hit truncates retirement at its window position, so the
+   retired stream is always a program-order prefix.
+4. **Fan-out** — kills/downgrades/promotions apply to holder lines by
+   tag at round end, exactly like ops/sync_engine (the vectorized
+   INV / WRITEBACK_INT / EVICT_SHARED-promotion fan-outs). A request
+   composing after a chain merges the two actions by severity; the
+   request's effect on the home's own line is carried separately
+   (act_home) since the home is excluded from its own action.
+
+Progress: a node's own-entry chains never lose arbitration, and the
+per-round reshuffled lane priority guarantees some requester wins each
+contended entry, so every trace drains (the runners assert the same
+claim-key round budget as ops/sync_engine).
+
+v1 simplifications (each truncates the window, costing rounds, never
+correctness): a write to a line the window filled by a remote *read*
+stops the window (the E/S fill ambiguity resolves in the committed
+cache by next round); re-touching a remote entry stops the window
+(own entries may be re-touched freely); slot-budget overflows stop
+the window.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ue22cs343bb1_openmp_assignment_tpu import codec
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.procedural import procedural_instr
+from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState, Op
+from ue22cs343bb1_openmp_assignment_tpu.ops.sync_engine import (
+    DM_ACT, DM_CLAIM, DM_COLS, DM_COUNT, DM_MEM, DM_OWNER, DM_REQ,
+    DM_STATE, SyncState, _round_key, claim_max_rounds)
+
+# slot kinds (remote events): fill requests and eviction notices
+K_NONE, K_RD, K_WR, K_UP, K_EVS, K_EVM, K_PROBE = 0, 1, 2, 3, 4, 5, 6
+
+# dense per-own-entry flag bits (fold output, reshaped [E], gathered by
+# remote events — never scattered)
+F_MARK, F_POISON = 1, 2
+
+# fan-out actions; matching sync_engine codes, packed for deep rounds as
+# DM_ACT = (round << 4) | (act_home << 2) | act_other
+ACT_NONE, ACT_DOWN, ACT_KILL, ACT_PROMOTE = 0, 1, 2, 3
+
+_INT_MAX = jnp.iinfo(jnp.int32).max
+
+
+def _sel_s(block, *regs):
+    """Read each node's column `block` from [N, S] registers via select
+    chains (S-way where): pure VPU arithmetic, no gather."""
+    outs = [r[:, 0] for r in regs]
+    S = regs[0].shape[1]
+    for s in range(1, S):
+        m = block == s
+        outs = [jnp.where(m, r[:, s], o) for r, o in zip(regs, outs)]
+    return outs
+
+
+def _upd_s(block, mask, updates_regs):
+    """Write per-node scalars into column `block` of [N, S] registers
+    where mask; updates_regs = [(new_vals, reg), ...]."""
+    S = updates_regs[0][1].shape[1]
+    s_iota = jnp.arange(S, dtype=jnp.int32)[None, :]
+    m2 = mask[:, None] & (block[:, None] == s_iota)          # [N, S]
+    return [jnp.where(m2, nv[:, None], reg) for nv, reg in updates_regs]
+
+
+def _fold_deep(cfg: SystemConfig, st: SyncState, w_oa, w_val, w_live,
+               trunc):
+    """The deep window fold as a lax.scan over window steps.
+
+    Pre-pass runs with trunc == W (attempt-everything) and consumes the
+    slot records + dense flags; replay runs with the resolved trunc and
+    consumes the committed cache/own-rows/counters. A scan (not a
+    static unroll) keeps the traced graph W-independent — in-loop
+    backedges are ~free on the bench device (PERF.md), while the
+    unrolled version's XLA compile time exploded with W.
+    """
+    N, C, S = cfg.num_nodes, cfg.cache_size, 1 << cfg.block_bits
+    W = cfg.drain_depth + cfg.txn_width
+    Q = cfg.deep_slots
+    G = cfg.deep_ownerval_slots
+    INV = int(CacheState.INVALID)
+    MOD = int(CacheState.MODIFIED)
+    EXC = int(CacheState.EXCLUSIVE)
+    SHD = int(CacheState.SHARED)
+    D_U, D_S, D_EM = int(DirState.U), int(DirState.S), int(DirState.EM)
+    rows = jnp.arange(N, dtype=jnp.int32)
+    c_iota = jnp.arange(C, dtype=jnp.int32)[None, :]
+    q_iota = jnp.arange(Q, dtype=jnp.int32)[None, :]
+    g_iota = jnp.arange(G, dtype=jnp.int32)[None, :]
+    s_iota = jnp.arange(S, dtype=jnp.int32)[None, :]
+    bmask = S - 1
+    zN = jnp.zeros((N,), jnp.int32)
+    dm_own = st.dm.reshape(N, S, DM_COLS)
+
+    carry0 = dict(
+        ca=st.cache_addr, cv=st.cache_val, cs=st.cache_state,
+        cv_src=jnp.full((N, C), -1, jnp.int32),
+        rrf=jnp.zeros((N, C), bool), wf=jnp.zeros((N, C), bool),
+        dms=dm_own[:, :, DM_STATE], dmc=dm_own[:, :, DM_COUNT],
+        dmo=dm_own[:, :, DM_OWNER], dmm=dm_own[:, :, DM_MEM],
+        dmm_src=jnp.full((N, S), -1, jnp.int32),
+        touched=jnp.zeros((N, S), bool),
+        act_acc=jnp.zeros((N, S), jnp.int32),
+        mark=jnp.zeros((N, S), bool),
+        poison=jnp.zeros((N, S), bool),
+        cv_req=st.cache_val,
+        cv_req_src=jnp.full((N, C), -1, jnp.int32),
+        stopped=jnp.zeros((N,), bool), frozen=jnp.zeros((N,), bool),
+        n_slot=zN, n_g=zN, seen_req=jnp.zeros((N,), bool),
+        n_ret=zN, rh=zN, wh=zN,
+        c_rd=zN, c_wr=zN, c_up=zN, c_ev=zN,
+        kind=jnp.zeros((N, Q), jnp.int32), ent=jnp.zeros((N, Q), jnp.int32),
+        sval=jnp.zeros((N, Q), jnp.int32),
+        pos=jnp.full((N, Q), W, jnp.int32),
+        g_owner=jnp.zeros((N, G), jnp.int32),
+        g_ci=jnp.zeros((N, G), jnp.int32),
+        k=jnp.zeros((), jnp.int32),
+    )
+
+    def body(c, x):
+        oa, val, live = x
+        k = c["k"]
+        # cache values as of the node's first fill-request attempt (and
+        # only committed writes can precede it in the replay pass):
+        # foreign requests read owner values from THIS snapshot, which
+        # keeps every value they observe inside the owner's pre-request
+        # stratum (module docstring)
+        cv_req = jnp.where(c["seen_req"][:, None], c["cv_req"], c["cv"])
+        cv_req_src = jnp.where(c["seen_req"][:, None], c["cv_req_src"],
+                               c["cv_src"])
+        op, addr = oa >> 28, oa & 0x0FFFFFFF
+        home = addr >> cfg.block_bits
+        block = addr & bmask
+        is_own = home == rows
+        ci = codec.cache_index(cfg, addr)
+        onehot = ci[:, None] == c_iota
+        l_addr, l_val, l_state, l_src, l_rrf_i, l_wf_i = _sel_s(
+            ci, c["ca"], c["cv"], c["cs"], c["cv_src"],
+            c["rrf"].astype(jnp.int32), c["wf"].astype(jnp.int32))
+        l_rrf, l_wf = l_rrf_i.astype(bool), l_wf_i.astype(bool)
+        tag_ok = (l_addr == addr) & (l_state != INV)
+        is_rd, is_wr = op == int(Op.READ), op == int(Op.WRITE)
+        rd_hit = live & is_rd & tag_ok
+        wr_hit = live & is_wr & tag_ok & ((l_state == MOD)
+                                          | (l_state == EXC))
+        wr_sh = live & is_wr & tag_ok & (l_state == SHD)
+        nop = live & (op == int(Op.NOP))
+        dep_stop = wr_sh & l_rrf               # v1: resolve next round
+        upg = wr_sh & ~l_rrf
+        rd_miss = live & is_rd & ~tag_ok
+        wr_miss = live & is_wr & ~tag_ok
+        is_txn = (upg | rd_miss | wr_miss) & ~dep_stop
+        hit = rd_hit | wr_hit | nop
+
+        has_victim = is_txn & ~tag_ok & (l_state != INV) & (l_addr != addr)
+        v_block = l_addr & bmask
+        v_own = (l_addr >> cfg.block_bits) == rows
+        v_mod = l_state == MOD
+
+        own_txn = is_txn & is_own
+        rem_txn = is_txn & ~is_own
+        own_vic = has_victim & v_own
+        rem_vic = has_victim & ~v_own
+        probe = hit & c["frozen"] & ~is_own & ~l_wf
+
+        # --- own register reads ------------------------------------------
+        t_dms, t_dmc, t_dmo, t_dmm, t_dmm_src, t_act = _sel_s(
+            block, c["dms"], c["dmc"], c["dmo"], c["dmm"], c["dmm_src"],
+            c["act_acc"])
+        v_dmc, v_act = _sel_s(v_block, c["dmc"], c["act_acc"])
+
+        # --- stop conditions ---------------------------------------------
+        n_need = (rem_txn.astype(jnp.int32) + rem_vic.astype(jnp.int32)
+                  + probe.astype(jnp.int32))
+        over_q = (c["n_slot"] + n_need) > Q
+        # EM-with-unresolved-owner (a same-round promotion, owner == -1)
+        # composes via the row's memory: SHARED lines are clean in this
+        # protocol (every downgrade/flush writes memory), so a
+        # promoted-E line's value equals mem
+        t_em_o = (t_dms == D_EM) & (t_dmo != rows) & (t_dmo >= 0)
+        t_em_p = (t_dms == D_EM) & (t_dmo == -1)
+        t_em = t_em_o | t_em_p
+        g_need = own_txn & (rd_miss | wr_miss) & t_em_o
+        over_g = g_need & (c["n_g"] >= G)
+        is_remev = ((c["kind"] >= K_RD) & (c["kind"] <= K_EVM))
+        dup = jnp.any(is_remev & (c["ent"] == addr[:, None]), axis=1) \
+            & rem_txn
+        dup = dup | (jnp.any(is_remev & (c["ent"] == l_addr[:, None]),
+                             axis=1) & rem_vic)
+        stop_now = (~c["stopped"]) & (live & ~nop) & (
+            dep_stop | over_q | over_g | dup
+            | ~(hit | is_txn))
+        stop_now = stop_now | ((~c["stopped"]) & ~live)
+        act = ~c["stopped"] & ~stop_now & (hit | is_txn)
+        r = act & (k < trunc)                  # retired this step
+
+        own_txn &= act
+        rem_txn &= act
+        own_vic &= act
+        rem_vic &= act
+        probe &= act
+        g_take = g_need & act
+
+        # --- slot emission (attempt-based) -------------------------------
+        e_vic = jnp.clip(l_addr, 0, N * S - 1)
+        e_fill = jnp.clip(addr, 0, N * S - 1)
+        o1 = c["n_slot"]
+        o2 = o1 + rem_vic.astype(jnp.int32)
+        kind, ent, sval, pos = c["kind"], c["ent"], c["sval"], c["pos"]
+        m1 = rem_vic[:, None] & (o1[:, None] == q_iota)
+        vic_kind = jnp.where(v_mod, K_EVM, K_EVS)
+        kind = jnp.where(m1, vic_kind[:, None], kind)
+        ent = jnp.where(m1, e_vic[:, None], ent)
+        sval = jnp.where(m1, l_val[:, None], sval)
+        pos = jnp.where(m1, k, pos)
+        fp = rem_txn | probe
+        m2 = fp[:, None] & (o2[:, None] == q_iota)
+        fill_kind = jnp.where(probe, K_PROBE,
+                              jnp.where(rd_miss, K_RD,
+                                        jnp.where(wr_miss, K_WR, K_UP)))
+        kind = jnp.where(m2, fill_kind[:, None], kind)
+        ent = jnp.where(m2, e_fill[:, None], ent)
+        slot_v = jnp.where(probe, c["seen_req"].astype(jnp.int32), val)
+        sval = jnp.where(m2, slot_v[:, None], sval)
+        pos = jnp.where(m2, k, pos)
+        n_slot = c["n_slot"] + jnp.where(act, n_need, 0)
+        seen_req = c["seen_req"] | rem_txn
+
+        # --- g-slot (own-EM owner value) ---------------------------------
+        g_sel = (g_iota == c["n_g"][:, None]) & g_take[:, None]
+        g_owner = jnp.where(g_sel, jnp.clip(t_dmo, 0, N - 1)[:, None],
+                            c["g_owner"])
+        g_ci = jnp.where(g_sel, ci[:, None], c["g_ci"])
+        g_id = c["n_g"]
+        n_g = c["n_g"] + g_take.astype(jnp.int32)
+
+        # --- counters ----------------------------------------------------
+        n_ret = c["n_ret"] + r
+        rh = c["rh"] + (rd_hit & r)
+        wh = c["wh"] + (wr_hit & r)
+        c_rd = c["c_rd"] + (rd_miss & r)
+        c_wr = c["c_wr"] + (wr_miss & r)
+        c_up = c["c_up"] + (upg & r)
+        c_ev = c["c_ev"] + (has_victim & r)
+
+        # --- hit write effects -------------------------------------------
+        wmask = (wr_hit & r)[:, None] & onehot
+        cv = jnp.where(wmask, val[:, None], c["cv"])
+        cv_src = jnp.where(wmask, -1, c["cv_src"])
+        cs = jnp.where(wmask, MOD, c["cs"])
+
+        # --- own victim composition --------------------------------------
+        vo = own_vic & r
+        ev_m = vo & v_mod
+        ev_e = vo & ~v_mod & (l_state == EXC)
+        ev_s = vo & ~v_mod & (l_state == SHD)
+        nvc = jnp.where(ev_s, v_dmc - 1, 0)
+        nvs = jnp.where(ev_s & (nvc >= 2), D_S,
+                        jnp.where(ev_s & (nvc == 1), D_EM, D_U))
+        promote = ev_s & (nvc == 1)
+        m2v = vo[:, None] & (v_block[:, None] == s_iota)
+        dms = jnp.where(m2v, nvs[:, None], c["dms"])
+        dmc = jnp.where(m2v, nvc[:, None], c["dmc"])
+        dmo = jnp.where(m2v & promote[:, None], -1, c["dmo"])
+        dmm = jnp.where(m2v & ev_m[:, None], l_val[:, None], c["dmm"])
+        dmm_src = jnp.where(m2v & ev_m[:, None], l_src[:, None],
+                            c["dmm_src"])
+        touched = c["touched"] | m2v
+        act_acc = jnp.where(
+            m2v, jnp.maximum(v_act, jnp.where(promote, ACT_PROMOTE,
+                                              ACT_NONE))[:, None],
+            c["act_acc"])
+        v_foreign = ev_s & (v_dmc > 1)
+        mark = c["mark"] | (m2v & v_foreign[:, None])
+        poison = c["poison"] | (m2v & c["seen_req"][:, None])
+
+        # --- own target composition --------------------------------------
+        to = own_txn & r
+        t_u_eff = (t_dms == D_U) | ((t_dms == D_EM) & (t_dmo == rows))
+        t_s = t_dms == D_S
+        o_rd, o_wr, o_up = to & rd_miss, to & wr_miss, to & upg
+        wlike = o_wr | o_up
+        nts = jnp.where(wlike | (o_rd & t_u_eff), D_EM, D_S)
+        ntc = jnp.where(wlike | (o_rd & t_u_eff), 1,
+                        jnp.where(o_rd & t_em, 2, t_dmc + 1))
+        nto = jnp.where(wlike | (o_rd & t_u_eff), rows, t_dmo)
+        flush = (o_rd | o_wr) & t_em_o
+        ntm_src = jnp.where(flush, g_id, t_dmm_src)
+        new_act = jnp.where(wlike & ~t_u_eff, ACT_KILL,
+                            jnp.where(o_rd & t_em, ACT_DOWN, ACT_NONE))
+        # touching a pending entry OVERRIDES the accumulated PROMOTE:
+        # promote-then-read nets a DOWNGRADE (the promotee may be an
+        # old E/M owner whose line the single composed action must
+        # still take to SHARED); promote-then-write kills it
+        act_override = to & t_em_p
+        m2t = to[:, None] & (block[:, None] == s_iota)
+        dms = jnp.where(m2t, nts[:, None], dms)
+        dmc = jnp.where(m2t, ntc[:, None], dmc)
+        dmo = jnp.where(m2t, nto[:, None], dmo)
+        dmm_src = jnp.where(m2t, ntm_src[:, None], dmm_src)
+        touched = touched | m2t
+        act_acc = jnp.where(
+            m2t, jnp.where(act_override,
+                           new_act, jnp.maximum(t_act, new_act))[:, None],
+            act_acc)
+        t_foreign = (t_s & (t_dmc > jnp.where(upg, 1, 0))) | t_em
+        mark = mark | (m2t & (to & t_foreign)[:, None])
+        poison = poison | (m2t & c["seen_req"][:, None])
+
+        # --- fills -------------------------------------------------------
+        fill = (own_txn | rem_txn) & r
+        fstate = jnp.where(is_wr, MOD,
+                           jnp.where(own_txn & t_u_eff, EXC, SHD))
+        f_val = jnp.where(is_wr, val, jnp.where(t_em_o, 0, t_dmm))
+        f_src = jnp.where(is_wr | ~is_own, -1,
+                          jnp.where(t_em_o, g_id, t_dmm_src))
+        fmask = fill[:, None] & onehot
+        ca = jnp.where(fmask, addr[:, None], c["ca"])
+        cv = jnp.where(fmask, f_val[:, None], cv)
+        cv_src = jnp.where(fmask, f_src[:, None], cv_src)
+        cs = jnp.where(fmask, fstate[:, None], cs)
+        rrf = jnp.where(fmask, (rem_txn & rd_miss)[:, None], c["rrf"])
+        wf = jnp.where(fmask, True, c["wf"])
+
+        frozen = c["frozen"] | (is_txn & ~c["stopped"] & ~stop_now)
+        stopped = c["stopped"] | stop_now
+        # yield records (resolved post-scatter against the own-slice
+        # lane): a chain TXN touch of an own entry yields to any fresh
+        # eviction notice there (at any position — notices never
+        # compose on touched rows) and to fresh fill requests when the
+        # touch sits after the node's own first fill-request attempt
+        # (the acyclicity rule); own-entry HITS after the first request
+        # yield to fresh fill requests only (notices never hurt a hit).
+        # The stratum bit rides in bit 16 of the block record (block
+        # indices are block_bits <= 16 wide; config enforces the cap).
+        post = c["seen_req"].astype(jnp.int32) << 16
+        y_t = jnp.where(own_txn, block | post, -1)
+        y_v = jnp.where(own_vic, v_block | post, -1)
+        y_h = jnp.where(act & is_own & (rd_hit | wr_hit)
+                        & c["seen_req"], block, -1)
+        out = dict(ca=ca, cv=cv, cs=cs, cv_src=cv_src, rrf=rrf, wf=wf,
+                   dms=dms, dmc=dmc, dmo=dmo, dmm=dmm, dmm_src=dmm_src,
+                   touched=touched, act_acc=act_acc,
+                   mark=mark, poison=poison, stopped=stopped,
+                   frozen=frozen, n_slot=n_slot, n_g=n_g,
+                   seen_req=seen_req, n_ret=n_ret, rh=rh, wh=wh,
+                   c_rd=c_rd, c_wr=c_wr, c_up=c_up, c_ev=c_ev,
+                   kind=kind, ent=ent, sval=sval, pos=pos,
+                   g_owner=g_owner, g_ci=g_ci, cv_req=cv_req,
+                   cv_req_src=cv_req_src, k=k + 1)
+        return out, (y_t, y_v, y_h)
+
+    xs = (w_oa.T, w_val.T, w_live.T)
+    fin, (y_t, y_v, y_h) = jax.lax.scan(body, carry0, xs, length=W)
+    fin["cnt"] = dict(rd_miss=fin["c_rd"], wr_miss=fin["c_wr"],
+                      upg=fin["c_up"], ev=fin["c_ev"])
+    fin["y_t"], fin["y_v"], fin["y_h"] = y_t, y_v, y_h   # [W, N]
+    return fin
+
+
+def round_step_deep(cfg: SystemConfig, st: SyncState) -> SyncState:
+    """One deep-window round. See module docstring for the design."""
+    N, C, S = cfg.num_nodes, cfg.cache_size, 1 << cfg.block_bits
+    E = N * S
+    W = cfg.drain_depth + cfg.txn_width
+    Q = cfg.deep_slots
+    G = cfg.deep_ownerval_slots
+    T = st.instr_pack.shape[1]
+    INV = int(CacheState.INVALID)
+    MOD = int(CacheState.MODIFIED)
+    EXC = int(CacheState.EXCLUSIVE)
+    SHD = int(CacheState.SHARED)
+    D_U, D_S, D_EM = int(DirState.U), int(DirState.S), int(DirState.EM)
+    rows = jnp.arange(N, dtype=jnp.int32)
+
+    # ---- instruction window ---------------------------------------------
+    offs = jnp.arange(W, dtype=jnp.int32)[None, :]
+    w_idx = st.idx[:, None] + offs
+    w_live = w_idx < st.instr_count[:, None]
+    if cfg.procedural:
+        w_oa, w_val = procedural_instr(cfg, rows[:, None], w_idx)
+    else:
+        w_flat = rows[:, None] * T + jnp.minimum(w_idx, T - 1)
+        w = st.instr_pack.reshape(N * T, 2)[w_flat]
+        w_oa, w_val = w[..., 0], w[..., 1]
+
+    # ---- pre-pass fold (attempt everything) ------------------------------
+    pre = _fold_deep(cfg, st, w_oa, w_val, w_live,
+                     jnp.full((N,), W, jnp.int32))
+    kind, ent, sval, pos = (pre["kind"], pre["ent"], pre["sval"],
+                            pre["pos"])
+    is_req = (kind == K_RD) | (kind == K_WR) | (kind == K_UP)
+    is_ev = (kind == K_EVS) | (kind == K_EVM)
+    is_probe = kind == K_PROBE
+
+    # ---- lane scatter (requests + notices only) --------------------------
+    # lane key layout: [countdown | prio | ev_bit] — arbitration among
+    # same-round events is priority-first (a node that wins one of its
+    # events wins all of them, so crossed evict/fill pairs cannot
+    # starve each other), with the ev bit as a tiebreak tag that lets
+    # the chain-yield and probe rules tell notices from fill requests
+    prio_bits = max(1, (N - 1).bit_length())
+    rk = _round_key(cfg, st, rows)
+    prio = rk & ((1 << prio_bits) - 1)
+    countdown = rk >> prio_bits
+    key = (countdown << (prio_bits + 1)) | (prio << 1)       # fill key
+    key_q = jnp.where(is_ev, key[:, None] | 1, key[:, None])  # [N, Q]
+    lane_idx = jnp.where(is_req | is_ev, ent, E).reshape(-1)
+    dm_claimed = st.dm.at[lane_idx, DM_CLAIM].min(
+        key_q.reshape(-1), mode="drop")
+
+    # ---- gathers: lane-back + dense home flags ---------------------------
+    safe_ent = jnp.clip(ent, 0, E - 1)
+    lane_got = dm_claimed[safe_ent, DM_CLAIM]                # [N, Q]
+    flags_arr = (pre["mark"].astype(jnp.int32) * F_MARK
+                 + pre["poison"].astype(jnp.int32) * F_POISON).reshape(E)
+    got_flags = flags_arr[safe_ent]                          # [N, Q]
+
+    # ---- truncation ------------------------------------------------------
+    # fresh lane keys this round sit strictly below every stale key (the
+    # DM_CLAIM countdown invariant, ops/sync_engine)
+    thresh = (jnp.maximum(claim_max_rounds(cfg) - st.round, 0) + 1) \
+        << (prio_bits + 1)
+    lane_fresh = lane_got < thresh
+    lane_is_ev = (lane_got & 1) == 1
+    won = lane_got == key_q
+    # priority symmetry-breaking between a home's chain and foreign
+    # events on its entries: the lower-priority side gives way, and the
+    # global-minimum-priority node never yields, aborts, or loses — so
+    # every round someone (in practice almost everyone) advances. The
+    # per-node priority is a pure bijection of the node id, so the
+    # home's priority needs no gather. Marks/poison are attempt-based
+    # (conservative): aborting on a ghost touch costs a retry, never
+    # soundness.
+    pmask = (1 << prio_bits) - 1
+    prio_self = prio                                          # [N]
+    prio_home = _round_key(cfg, st, safe_ent >> cfg.block_bits) & pmask
+    home_wins = prio_home < prio_self[:, None]               # [N, Q]
+    req_bad = is_req & (~won | (((got_flags & F_POISON) != 0)
+                                & home_wins))
+    ev_bad = is_ev & (~won | (((got_flags & F_MARK) != 0)
+                              & home_wins))
+    # probes: a fresh marker (the entry's home chain-transacted on it)
+    # is always unsafe; a fresh foreign FILL request is unsafe only for
+    # hits after the node's own first fill request (pre-request hits
+    # serialize before all requests — sval carries the stratum bit);
+    # eviction notices never endanger a hit
+    probe_bad = is_probe & (((got_flags & F_MARK) != 0)
+                            | ((sval != 0) & lane_fresh & ~lane_is_ev))
+    bad = req_bad | ev_bad | probe_bad
+    trunc = jnp.min(jnp.where(bad, pos, W), axis=1)          # [N]
+    # chain-yield rule (dense own-slice reads — own entries are never
+    # our own lane targets, so any fresh key there is foreign): a chain
+    # TXN touch yields to a fresh notice at any position and to a fresh
+    # fill request after our first request attempt; post-request own
+    # HITS yield to fresh fill requests
+    own_lane = dm_claimed.reshape(N, S, DM_COLS)[:, :, DM_CLAIM]
+    o_fresh = own_lane < thresh                              # [N, S]
+    o_ev = (own_lane & 1) == 1
+    o_beats = ((own_lane >> 1) & pmask) < prio_self[:, None]  # sender wins
+    # per-entry code: 1 = fresh, 2 = fresh EV, 4 = fresh & sender beats
+    # the home's priority
+    o_code = (o_fresh.astype(jnp.int32)
+              | (o_fresh & o_ev).astype(jnp.int32) * 2
+              | (o_fresh & o_beats).astype(jnp.int32) * 4)   # [N, S]
+    for k in range(W):
+        unsafe = jnp.zeros((N,), bool)
+        for y in (pre["y_t"][k], pre["y_v"][k]):
+            blockk = jnp.clip(y & 0xFFFF, 0, S - 1)
+            post = (y >= 0) & ((y >> 16) & 1).astype(bool)
+            code = _sel_s(blockk, o_code)[0]
+            fresh_ev = (code & 2) == 2
+            beats = (code & 4) == 4
+            # chain TXN touches: yield to a winning fresh notice at any
+            # position; after our first fill request, yield to any
+            # winning fresh event
+            unsafe |= (y >= 0) & beats & (fresh_ev | post)
+        yh = pre["y_h"][k]
+        code = _sel_s(jnp.clip(yh, 0, S - 1), o_code)[0]
+        # post-request own hits always defer to a fresh fill request
+        # (the request may kill this line; hits probe no lane, so the
+        # conservative side is ours). Notices never hurt a hit.
+        unsafe |= (yh >= 0) & ((code & 1) == 1) & ((code & 2) == 0)
+        trunc = jnp.minimum(trunc, jnp.where(unsafe, k, W))
+
+    # ---- replay fold (committed prefix) ----------------------------------
+    rp = _fold_deep(cfg, st, w_oa, w_val, w_live, trunc)
+
+    # ---- dense merge of own rows -----------------------------------------
+    rtag = st.round << 4
+    act_col = jnp.where(
+        rp["touched"],
+        rtag | rp["act_acc"],                 # act_home=0 for chain rows
+        dm_own_col(st, DM_ACT, N, S))
+    # g-slot owner values from the committed cache (phase-H writes only
+    # can precede — mid-window foreign hit-writes on marked entries
+    # truncate, so cv_post is the serialization-consistent source)
+    g_flat = jnp.clip(rp["g_owner"], 0, N - 1) * C + rp["g_ci"]
+    g_vals = rp["cv_req"].reshape(-1)[g_flat]                # [N, G]
+    dmm_m = rp["dmm"]
+    cv_m = rp["cv"]
+    cv_req_m = rp["cv_req"]
+    for g in range(G):
+        dmm_m = jnp.where(rp["dmm_src"] == g, g_vals[:, g:g + 1], dmm_m)
+        cv_m = jnp.where(rp["cv_src"] == g, g_vals[:, g:g + 1], cv_m)
+        cv_req_m = jnp.where(rp["cv_req_src"] == g, g_vals[:, g:g + 1],
+                             cv_req_m)
+    merged = jnp.stack([
+        jnp.where(rp["touched"], rp["dms"],
+                  dm_own_col(st, DM_STATE, N, S)),
+        jnp.where(rp["touched"], rp["dmc"],
+                  dm_own_col(st, DM_COUNT, N, S)),
+        jnp.where(rp["touched"], rp["dmo"],
+                  dm_own_col(st, DM_OWNER, N, S)),
+        jnp.where(rp["touched"], dmm_m, dm_own_col(st, DM_MEM, N, S)),
+        act_col,
+        jnp.where(rp["touched"], jnp.broadcast_to(rows[:, None], (N, S)),
+                  dm_own_col(st, DM_REQ, N, S)),
+        dm_claimed.reshape(N, S, DM_COLS)[:, :, DM_CLAIM],
+    ], axis=-1).reshape(E, DM_COLS)
+    dm = merged
+
+    # ---- request composition (post-merge, per committed slot) ------------
+    commit = (is_req | is_ev) & won & (pos < trunc[:, None])
+    g_rows = dm[safe_ent]                                    # [N, Q, cols]
+    r_state = g_rows[..., DM_STATE]
+    r_cnt = g_rows[..., DM_COUNT]
+    r_own = g_rows[..., DM_OWNER]
+    r_mem = g_rows[..., DM_MEM]
+    r_act = g_rows[..., DM_ACT]
+    r_ci = codec.cache_index(cfg, safe_ent)
+    # a pending row (same-round promotion, owner == -1) serves its
+    # memory as the owner value: SHARED lines are clean, and the
+    # promoted-E line's value equals mem
+    r_pend = (r_state == D_EM) & (r_own == -1)
+    own_val = jnp.where(
+        r_pend, r_mem,
+        cv_req_m.reshape(-1)[jnp.clip(r_own, 0, N - 1) * C + r_ci])
+    r_u = r_state == D_U
+    r_s = r_state == D_S
+    r_em = r_state == D_EM
+    k_rd = commit & (kind == K_RD)
+    k_wr = commit & (kind == K_WR)
+    k_up = commit & (kind == K_UP)
+    k_evs = commit & (kind == K_EVS)
+    k_evm = commit & (kind == K_EVM)
+    wlike = k_wr | k_up
+    # new row from composition. An EVICT_SHARED from an E-line holder
+    # finds the row EM{evictor} (exactness) and leaves it Uncached —
+    # the reference's clear-bit -> 0 sharers path (assignment.c:560-570)
+    evs_cnt = jnp.where(r_s, r_cnt - 1, r_cnt)
+    n_state = jnp.where(wlike, D_EM,
+               jnp.where(k_rd, jnp.where(r_u, D_EM, D_S),
+                jnp.where(k_evm | (k_evs & r_em), D_U,
+                 jnp.where(k_evs & r_s,
+                           jnp.where(evs_cnt == 0, D_U,
+                                     jnp.where(evs_cnt == 1, D_EM, D_S)),
+                           r_state))))
+    n_cnt = jnp.where(wlike | (k_rd & r_u), 1,
+             jnp.where(k_rd & r_em, 2,
+              jnp.where(k_rd & r_s, r_cnt + 1,
+               jnp.where(k_evm | (k_evs & r_em), 0,
+                jnp.where(k_evs & r_s, evs_cnt, r_cnt)))))
+    req_id = jnp.broadcast_to(rows[:, None], (N, Q))
+    n_own = jnp.where(wlike | (k_rd & r_u), req_id,
+             jnp.where(k_evs & r_s & (evs_cnt == 1), -1, r_own))
+    n_mem = jnp.where((k_rd | k_wr) & r_em, own_val,
+                      jnp.where(k_evm, sval, r_mem))
+    # fan-out action composition: requester's own effect on other
+    # holders, merged by severity with the chain's fresh action
+    my_act = jnp.where(wlike, ACT_KILL,
+              jnp.where(k_rd & r_em, ACT_DOWN,
+               jnp.where(k_evs & r_s & (evs_cnt == 1), ACT_PROMOTE,
+                         ACT_NONE)))
+    chain_fresh = (r_act >> 4) == st.round
+    chain_act = jnp.where(chain_fresh, r_act & 3, ACT_NONE)
+    # promote-then-X overrides: a read nets a DOWNGRADE (the promotee
+    # may be an old E/M owner — the one composed action must still take
+    # its line to SHARED); a write kills it; a notice means the
+    # promotee itself evicted (no holders left, no action)
+    act_o = jnp.where(chain_act == ACT_PROMOTE,
+                      jnp.where(wlike, ACT_KILL,
+                                jnp.where(k_rd, ACT_DOWN, ACT_NONE)),
+                      jnp.maximum(chain_act, my_act))
+    act_h = my_act                             # effect on the home's line
+    n_act = rtag | (act_h << 2) | act_o
+    # pending flag for rows we leave EM with unknown owner
+    t_idx = jnp.where(commit, safe_ent, E).reshape(-1)
+    t_rows = jnp.stack(
+        [n_state, n_cnt, n_own, n_mem, n_act, req_id, key_q],
+        axis=-1).reshape(-1, DM_COLS)
+    dm = dm.at[t_idx].set(t_rows, mode="drop")
+
+    # ---- reply patches on the requester's cache --------------------------
+    # committed remote rd fills resolve E vs S and the fill value here
+    fill_e = k_rd & r_u
+    fill_val = jnp.where(r_em, own_val, r_mem)
+    ca_c, cv_c, cs_c = rp["ca"], cv_m, rp["cs"]
+    c_iota = jnp.arange(C, dtype=jnp.int32)[None, :]
+    for q in range(Q):
+        oh = (r_ci[:, q][:, None] == c_iota) & k_rd[:, q][:, None]
+        cs_c = jnp.where(oh & fill_e[:, q][:, None], EXC, cs_c)
+        cv_c = jnp.where(oh, fill_val[:, q][:, None], cv_c)
+
+    # ---- fan-out ---------------------------------------------------------
+    line_e = jnp.clip(ca_c, 0, E - 1)
+    line_dm = dm[line_e]                                     # [N, C, cols]
+    fresh = (line_dm[..., DM_ACT] >> 4) == st.round
+    l_act_h = jnp.where(fresh, (line_dm[..., DM_ACT] >> 2) & 3, ACT_NONE)
+    l_act_o = jnp.where(fresh, line_dm[..., DM_ACT] & 3, ACT_NONE)
+    l_req = line_dm[..., DM_REQ]
+    l_home = line_e >> cfg.block_bits
+    i_am_home = l_home == rows[:, None]
+    a_code = jnp.where(i_am_home, l_act_h, l_act_o)
+    valid = cs_c != INV
+    not_self = l_req != rows[:, None]
+    kill = valid & not_self & (a_code == ACT_KILL)
+    down = valid & not_self & (a_code == ACT_DOWN)
+    promo = valid & not_self & (a_code == ACT_PROMOTE)
+    cs_c = jnp.where(kill, INV,
+                     jnp.where(down, SHD,
+                               jnp.where(promo, EXC, cs_c)))
+    dm = dm.at[jnp.where(promo, line_e, E).reshape(-1), DM_OWNER].set(
+        jnp.broadcast_to(rows[:, None], (N, C)).reshape(-1), mode="drop")
+
+    # ---- bookkeeping -----------------------------------------------------
+    # replay counters already include retired *remote* transactions (a
+    # remote txn retires iff its slots committed — both encoded in
+    # trunc), so the committed-slot sums are not added again
+    cntr = rp["cnt"]
+    deltas = jnp.sum(jnp.stack([
+        rp["n_ret"], rp["rh"], rp["wh"],
+        cntr["rd_miss"],
+        cntr["wr_miss"],
+        cntr["upg"],
+        jnp.sum((is_req | is_ev) & ~won, axis=1, dtype=jnp.int32),
+        cntr["ev"],
+        jnp.sum(kill, axis=1, dtype=jnp.int32),
+        jnp.sum(promo, axis=1, dtype=jnp.int32),
+    ]), axis=1)
+    mt = st.metrics
+    metrics = mt.replace(
+        rounds=mt.rounds + 1,
+        instrs_retired=mt.instrs_retired + deltas[0],
+        read_hits=mt.read_hits + deltas[1],
+        write_hits=mt.write_hits + deltas[2],
+        read_misses=mt.read_misses + deltas[3],
+        write_misses=mt.write_misses + deltas[4],
+        upgrades=mt.upgrades + deltas[5],
+        conflicts=mt.conflicts + deltas[6],
+        evictions=mt.evictions + deltas[7],
+        invalidations=mt.invalidations + deltas[8],
+        promotions=mt.promotions + deltas[9],
+    )
+    return st.replace(cache_addr=ca_c, cache_val=cv_c, cache_state=cs_c,
+                      dm=dm, idx=st.idx + rp["n_ret"],
+                      round=st.round + 1, metrics=metrics)
+
+
+def dm_own_col(st: SyncState, col: int, N: int, S: int):
+    return st.dm.reshape(N, S, DM_COLS)[:, :, col]

@@ -264,9 +264,14 @@ def _assert_round_budget(cfg: SystemConfig, start_round, n: int) -> None:
 
 
 def claim_max_rounds(cfg: SystemConfig) -> int:
-    """Hard bound on rounds per machine (DM_CLAIM key-packing budget)."""
+    """Hard bound on rounds per machine (DM_CLAIM key-packing budget).
+
+    The deep-window engine spends one extra key bit distinguishing
+    eviction notices from fill requests in the lane (ops/deep_engine),
+    halving the round budget."""
     prio_bits = max(1, (cfg.num_nodes - 1).bit_length())
-    return (1 << (30 - prio_bits)) - 1
+    extra = 1 if cfg.deep_window else 0
+    return (1 << (30 - prio_bits - extra)) - 1
 
 
 def check_exact_directory(cfg: SystemConfig, st: SyncState) -> dict:
@@ -397,6 +402,14 @@ def round_step(cfg: SystemConfig, st: SyncState,
     dispatch. cfg.pallas_burst routes the window fold through fused
     Pallas kernels on procedural workloads (ops.pallas_burst /
     ops.pallas_window), bit-identically."""
+    if cfg.deep_window:
+        if with_events:
+            raise NotImplementedError(
+                "event tracing is served by the async/multi engines; "
+                "the deep-window engine is the throughput path")
+        from ue22cs343bb1_openmp_assignment_tpu.ops.deep_engine import (
+            round_step_deep)
+        return round_step_deep(cfg, st)
     if cfg.pallas_burst and cfg.procedural and not with_events:
         from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_burst
         use_pallas = pallas_burst.tileable(cfg.num_nodes)
@@ -414,7 +427,7 @@ def round_step(cfg: SystemConfig, st: SyncState,
 
 def _round_step_single(cfg: SystemConfig, st: SyncState,
                        with_events: bool = False,
-                       use_pallas: bool | None = None):
+                       use_pallas: bool = False):
     """Advance every node by one burst of hits plus one transaction.
 
     ``with_events=True`` additionally returns this round's retirement
@@ -434,11 +447,6 @@ def _round_step_single(cfg: SystemConfig, st: SyncState,
     idx0 = st.idx
 
     c_iota = jnp.arange(C, dtype=jnp.int32)
-    if use_pallas is None:
-        from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_burst
-        use_pallas = (cfg.pallas_burst and cfg.procedural
-                      and not with_events
-                      and pallas_burst.tileable(cfg.num_nodes))
     if use_pallas:
         # ---- phases 1-2a as ONE fused Pallas kernel (ops.pallas_burst;
         # flag-gated — see that module's docstring for the economics)
