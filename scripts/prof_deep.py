@@ -1,0 +1,82 @@
+"""Deep-engine throughput sweep on the attached TPU.
+
+Measures sustained instrs/sec at the headline config (4096 nodes,
+procedural uniform local_frac 0.8) across window length W and slot
+budgets, against the multi-txn engine baseline. Timing: device_get
+sync, median of reps, one-dispatch runs (chunked while_loop).
+"""
+
+import argparse
+import dataclasses
+import time
+
+import jax
+import numpy as np
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+
+
+def run_cfg(cfg, length, chunk=64, reps=3, max_rounds=60_000):
+    st0 = se.procedural_state(cfg, length)
+
+    def run():
+        return se.run_sync_to_quiescence(cfg, st0, chunk, max_rounds)
+
+    out = run()
+    retired = int(np.asarray(out.metrics.instrs_retired))  # warm + sync
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = run()
+        retired = int(np.asarray(out.metrics.instrs_retired))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    el = times[len(times) // 2]
+    rounds = int(np.asarray(out.metrics.rounds))
+    q = bool(out.quiescent())
+    return retired / el, rounds, retired, q, el
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=4096)
+    ap.add_argument("--len", type=int, default=4096)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--baseline", action="store_true")
+    args = ap.parse_args()
+    N, L = args.nodes, args.len
+    print(f"backend={jax.default_backend()} N={N} len={L}")
+
+    if args.baseline:
+        cfg = SystemConfig.scale(N, drain_depth=4, txn_width=3)
+        cfg = dataclasses.replace(cfg, procedural="uniform", max_instrs=1,
+                                  pallas_burst=True)
+        r, rounds, ret, q, el = run_cfg(cfg, L, reps=args.reps)
+        print(f"multi K=3 pallas: {r:.3e} i/s rounds={rounds} q={q} "
+              f"({ret/rounds/N:.2f}/node/round, {el*1e3/rounds:.2f} ms/round)")
+
+    for (dd, tw, Q, G) in [
+        (13, 3, 6, 3),
+        (13, 3, 8, 4),
+        (21, 3, 8, 4),
+        (29, 3, 10, 4),
+        (45, 3, 12, 4),
+        (5, 3, 6, 3),
+    ]:
+        cfg = SystemConfig.scale(N, drain_depth=dd, txn_width=tw)
+        cfg = dataclasses.replace(cfg, procedural="uniform", max_instrs=1,
+                                  deep_window=True, deep_slots=Q,
+                                  deep_ownerval_slots=G)
+        try:
+            r, rounds, ret, q, el = run_cfg(cfg, L, reps=args.reps)
+        except Exception as e:
+            print(f"deep W={dd+tw} Q={Q} G={G}: FAILED {str(e)[:100]}")
+            continue
+        print(f"deep W={dd+tw} Q={Q} G={G}: {r:.3e} i/s rounds={rounds} "
+              f"q={q} ({ret/rounds/N:.2f}/node/round, "
+              f"{el*1e3/rounds:.2f} ms/round)")
+
+
+if __name__ == "__main__":
+    main()

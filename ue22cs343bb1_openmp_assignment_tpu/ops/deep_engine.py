@@ -205,14 +205,19 @@ def _fold_deep(cfg: SystemConfig, st: SyncState, w_oa, w_val, w_live,
         kind=jnp.zeros((N, Q), jnp.int32), ent=jnp.zeros((N, Q), jnp.int32),
         sval=jnp.zeros((N, Q), jnp.int32),
         pos=jnp.full((N, Q), W, jnp.int32),
+        rel=jnp.zeros((N, Q), bool), relv=jnp.zeros((N, Q), jnp.int32),
+        reld=jnp.zeros((N, Q), bool),
         g_owner=jnp.zeros((N, G), jnp.int32),
         g_ci=jnp.zeros((N, G), jnp.int32),
         k=jnp.zeros((), jnp.int32),
     )
 
+    horizon = st.horizon
+
     def body(c, x):
         oa, val, live = x
         k = c["k"]
+        live = live & (k < horizon)
         # cache values as of the node's first fill-request attempt (and
         # only committed writes can precede it in the replay pass):
         # foreign requests read owner values from THIS snapshot, which
@@ -263,7 +268,11 @@ def _fold_deep(cfg: SystemConfig, st: SyncState, w_oa, w_val, w_live,
         v_dmc, v_act = _sel_s(v_block, c["dmc"], c["act_acc"])
 
         # --- stop conditions ---------------------------------------------
-        n_need = (rem_txn.astype(jnp.int32) + rem_vic.astype(jnp.int32)
+        n_need = (rem_txn.astype(jnp.int32)
+                  + (rem_vic & ~(jnp.any(
+                      ((c["kind"] >= K_RD) & (c["kind"] <= K_UP))
+                      & (c["ent"] == l_addr[:, None]), axis=1)))
+                  .astype(jnp.int32)
                   + probe.astype(jnp.int32))
         over_q = (c["n_slot"] + n_need) > Q
         # EM-with-unresolved-owner (a same-round promotion, owner == -1)
@@ -276,10 +285,17 @@ def _fold_deep(cfg: SystemConfig, st: SyncState, w_oa, w_val, w_live,
         g_need = own_txn & (rd_miss | wr_miss) & t_em_o
         over_g = g_need & (c["n_g"] >= G)
         is_remev = ((c["kind"] >= K_RD) & (c["kind"] <= K_EVM))
+        # release: displacing a line WE filled via an earlier window
+        # request composes the eviction into that request's slot (we
+        # hold the entry's lane, so the fill+evict net row commits as
+        # one write) instead of stopping the window
+        is_fill_slot = (c["kind"] >= K_RD) & (c["kind"] <= K_UP)
+        rel_hit = is_fill_slot & (c["ent"] == l_addr[:, None])   # [N, Q]
+        rel_any = jnp.any(rel_hit, axis=1) & rem_vic
         dup = jnp.any(is_remev & (c["ent"] == addr[:, None]), axis=1) \
             & rem_txn
         dup = dup | (jnp.any(is_remev & (c["ent"] == l_addr[:, None]),
-                             axis=1) & rem_vic)
+                             axis=1) & rem_vic & ~rel_any)
         stop_now = (~c["stopped"]) & (live & ~nop) & (
             dep_stop | over_q | over_g | dup
             | ~(hit | is_txn))
@@ -298,9 +314,18 @@ def _fold_deep(cfg: SystemConfig, st: SyncState, w_oa, w_val, w_live,
         e_vic = jnp.clip(l_addr, 0, N * S - 1)
         e_fill = jnp.clip(addr, 0, N * S - 1)
         o1 = c["n_slot"]
-        o2 = o1 + rem_vic.astype(jnp.int32)
+        rem_vic_slot = rem_vic & ~rel_any
+        o2 = o1 + rem_vic_slot.astype(jnp.int32)
         kind, ent, sval, pos = c["kind"], c["ent"], c["sval"], c["pos"]
-        m1 = rem_vic[:, None] & (o1[:, None] == q_iota)
+        # gate by retirement, not attempt: in the replay pass a
+        # displacement past the truncation point must not release its
+        # fill slot (the fill would commit a net row for an eviction
+        # that never happened)
+        mrel = rel_hit & (rem_vic & (k < trunc))[:, None]
+        rel = c["rel"] | mrel
+        relv = jnp.where(mrel, l_val[:, None], c["relv"])
+        reld = c["reld"] | (mrel & v_mod[:, None])
+        m1 = rem_vic_slot[:, None] & (o1[:, None] == q_iota)
         vic_kind = jnp.where(v_mod, K_EVM, K_EVS)
         kind = jnp.where(m1, vic_kind[:, None], kind)
         ent = jnp.where(m1, e_vic[:, None], ent)
@@ -439,6 +464,7 @@ def _fold_deep(cfg: SystemConfig, st: SyncState, w_oa, w_val, w_live,
                    seen_req=seen_req, n_ret=n_ret, rh=rh, wh=wh,
                    c_rd=c_rd, c_wr=c_wr, c_up=c_up, c_ev=c_ev,
                    kind=kind, ent=ent, sval=sval, pos=pos,
+                   rel=rel, relv=relv, reld=reld,
                    g_owner=g_owner, g_ci=g_ci, cv_req=cv_req,
                    cv_req_src=cv_req_src, k=k + 1)
         return out, (y_t, y_v, y_h)
@@ -638,6 +664,11 @@ def round_step_deep(cfg: SystemConfig, st: SyncState) -> SyncState:
     k_evs = commit & (kind == K_EVS)
     k_evm = commit & (kind == K_EVM)
     wlike = k_wr | k_up
+    # release: the requester displaced its own window fill of this
+    # entry later in the window (replay-gated, so only committed
+    # displacements count); the slot commits the fill+evict NET row
+    rel = rp["rel"] & (k_rd | wlike)
+    relv, reld = rp["relv"], rp["reld"]
     # new row from composition. An EVICT_SHARED from an E-line holder
     # finds the row EM{evictor} (exactness) and leaves it Uncached —
     # the reference's clear-bit -> 0 sharers path (assignment.c:560-570)
@@ -659,23 +690,46 @@ def round_step_deep(cfg: SystemConfig, st: SyncState) -> SyncState:
              jnp.where(k_evs & r_s & (evs_cnt == 1), -1, r_own))
     n_mem = jnp.where((k_rd | k_wr) & r_em, own_val,
                       jnp.where(k_evm, sval, r_mem))
-    # fan-out action composition: requester's own effect on other
-    # holders, merged by severity with the chain's fresh action
-    my_act = jnp.where(wlike, ACT_KILL,
-              jnp.where(k_rd & r_em, ACT_DOWN,
-               jnp.where(k_evs & r_s & (evs_cnt == 1), ACT_PROMOTE,
-                         ACT_NONE)))
+    # release net-row overrides: a released read leaves the row as it
+    # was (EM keeps its owner, memory takes the owner's flushed value);
+    # a released write nets Uncached with our final written value
+    n_state = jnp.where(rel, jnp.where(wlike, D_U,
+                                       jnp.where(r_em, D_EM, r_state)),
+                        n_state)
+    n_cnt = jnp.where(rel, jnp.where(wlike, 0,
+                                     jnp.where(r_em, 1, r_cnt)), n_cnt)
+    n_own = jnp.where(rel, r_own, n_own)
+    n_mem = jnp.where(rel, jnp.where(wlike, relv,
+                                     jnp.where(r_em, own_val, r_mem)),
+                      n_mem)
+    # fan-out action composition, split by target: the home's own line
+    # takes act_h, every other tag-matching holder takes act_o.
+    # Downgrade/promote are targeted at the row's recorded owner, which
+    # may or may not be the home's line.
+    tgt_home = r_own == (safe_ent >> cfg.block_bits)
+    my_h = jnp.where(wlike, ACT_KILL,
+            jnp.where(k_rd & r_em & tgt_home,
+                      jnp.where(rel, ACT_PROMOTE, ACT_DOWN),
+             jnp.where(k_evs & r_s & (evs_cnt == 1), ACT_PROMOTE,
+                       ACT_NONE)))
+    my_o = jnp.where(wlike, ACT_KILL,
+            jnp.where(k_rd & r_em & ~tgt_home,
+                      jnp.where(rel, ACT_PROMOTE, ACT_DOWN),
+             jnp.where(k_evs & r_s & (evs_cnt == 1), ACT_PROMOTE,
+                       ACT_NONE)))
     chain_fresh = (r_act >> 4) == st.round
     chain_act = jnp.where(chain_fresh, r_act & 3, ACT_NONE)
-    # promote-then-X overrides: a read nets a DOWNGRADE (the promotee
-    # may be an old E/M owner — the one composed action must still take
-    # its line to SHARED); a write kills it; a notice means the
-    # promotee itself evicted (no holders left, no action)
+    # promote-then-X overrides: a plain read nets a DOWNGRADE (the
+    # promotee may be an old E/M owner — the one composed action must
+    # still take its line to SHARED); a released read re-promotes; a
+    # write kills; a notice means the promotee itself evicted
     act_o = jnp.where(chain_act == ACT_PROMOTE,
                       jnp.where(wlike, ACT_KILL,
-                                jnp.where(k_rd, ACT_DOWN, ACT_NONE)),
-                      jnp.maximum(chain_act, my_act))
-    act_h = my_act                             # effect on the home's line
+                                jnp.where(k_rd & rel, ACT_PROMOTE,
+                                          jnp.where(k_rd, ACT_DOWN,
+                                                    ACT_NONE))),
+                      jnp.maximum(chain_act, my_o))
+    act_h = my_h                               # effect on the home's line
     n_act = rtag | (act_h << 2) | act_o
     # pending flag for rows we leave EM with unknown owner
     t_idx = jnp.where(commit, safe_ent, E).reshape(-1)
@@ -688,10 +742,11 @@ def round_step_deep(cfg: SystemConfig, st: SyncState) -> SyncState:
     # committed remote rd fills resolve E vs S and the fill value here
     fill_e = k_rd & r_u
     fill_val = jnp.where(r_em, own_val, r_mem)
+    patch = k_rd & ~rel          # a released fill's line was displaced
     ca_c, cv_c, cs_c = rp["ca"], cv_m, rp["cs"]
     c_iota = jnp.arange(C, dtype=jnp.int32)[None, :]
     for q in range(Q):
-        oh = (r_ci[:, q][:, None] == c_iota) & k_rd[:, q][:, None]
+        oh = (r_ci[:, q][:, None] == c_iota) & patch[:, q][:, None]
         cs_c = jnp.where(oh & fill_e[:, q][:, None], EXC, cs_c)
         cv_c = jnp.where(oh, fill_val[:, q][:, None], cv_c)
 
@@ -747,6 +802,7 @@ def round_step_deep(cfg: SystemConfig, st: SyncState) -> SyncState:
     )
     return st.replace(cache_addr=ca_c, cache_val=cv_c, cache_state=cs_c,
                       dm=dm, idx=st.idx + rp["n_ret"],
+                      horizon=jnp.clip(rp["n_ret"] + 2, 2, 1 << 20),
                       round=st.round + 1, metrics=metrics)
 
 

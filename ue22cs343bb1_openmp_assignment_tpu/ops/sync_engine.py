@@ -137,6 +137,13 @@ class SyncState(struct.PyTreeNode):
     instr_count: jnp.ndarray  # [N] i32
     idx: jnp.ndarray          # [N] i32: next instruction to execute
 
+    # deep-window attempt horizon (ops.deep_engine): per-node cap on how
+    # far the window fold ATTEMPTS each round, adapted AIMD-style
+    # (committed + 2, decays on truncation). Bounds the "ghost" events
+    # that uncommitted attempts park in lanes/flags, which otherwise
+    # inflate contention quadratically. Inert for the other engines.
+    horizon: jnp.ndarray      # [N] i32
+
     seed: jnp.ndarray         # [] i32 arbitration seed (schedule knob)
     round: jnp.ndarray        # [] i32
     metrics: SyncMetrics
@@ -178,6 +185,7 @@ def from_sim_state(cfg: SystemConfig, st: SimState, seed: int = 0) -> SyncState:
             [(st.instr_op << 28) | st.instr_addr, st.instr_val], axis=-1),
         instr_count=st.instr_count,
         idx=jnp.zeros((N,), jnp.int32),
+        horizon=jnp.full((N,), 1 << 20, jnp.int32),
         seed=jnp.asarray(seed, jnp.int32),
         round=jnp.zeros((), jnp.int32),
         metrics=SyncMetrics.zeros(),
@@ -236,6 +244,7 @@ def continue_with_traces(cfg: SystemConfig, st: SyncState, traces=None,
         instr_pack=jnp.stack([(op << 28) | addr, val], axis=-1),
         instr_count=count,
         idx=jnp.zeros((cfg.num_nodes,), jnp.int32),
+        horizon=jnp.full((cfg.num_nodes,), 1 << 20, jnp.int32),
         round=jnp.zeros((), jnp.int32))
 
 

@@ -117,6 +117,13 @@ def load_checkpoint(path: str) -> Tuple[SystemConfig, SimState, dict]:
             state_fields[name] = arr
     expected = set(f.name for f in dataclasses.fields(state_cls))
     got = set(state_fields) | {"metrics"}
+    # fields added after a checkpoint was written get their neutral
+    # init (currently only the deep-window attempt horizon)
+    if "horizon" in expected and "horizon" not in got:
+        n = state_fields["idx"].shape[-1]
+        state_fields["horizon"] = np.full(
+            state_fields["idx"].shape[:-1] + (n,), 1 << 20, np.int32)
+        got.add("horizon")
     if got != expected:
         raise ValueError(f"checkpoint fields {sorted(got)} != "
                          f"state fields {sorted(expected)}")
