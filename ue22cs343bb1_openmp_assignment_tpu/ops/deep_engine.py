@@ -136,345 +136,57 @@ ACT_NONE, ACT_DOWN, ACT_KILL, ACT_PROMOTE = 0, 1, 2, 3
 _INT_MAX = jnp.iinfo(jnp.int32).max
 
 
-def _sel_s(block, *regs):
-    """Read each node's column `block` from [N, S] registers via select
-    chains (S-way where): pure VPU arithmetic, no gather."""
-    outs = [r[:, 0] for r in regs]
-    S = regs[0].shape[1]
-    for s in range(1, S):
-        m = block == s
-        outs = [jnp.where(m, r[:, s], o) for r, o in zip(regs, outs)]
-    return outs
-
-
-def _upd_s(block, mask, updates_regs):
-    """Write per-node scalars into column `block` of [N, S] registers
-    where mask; updates_regs = [(new_vals, reg), ...]."""
-    S = updates_regs[0][1].shape[1]
-    s_iota = jnp.arange(S, dtype=jnp.int32)[None, :]
-    m2 = mask[:, None] & (block[:, None] == s_iota)          # [N, S]
-    return [jnp.where(m2, nv[:, None], reg) for nv, reg in updates_regs]
-
-
 def _fold_deep(cfg: SystemConfig, st: SyncState, w_oa, w_val, w_live,
-               trunc):
-    """The deep window fold as a lax.scan over window steps.
+               bad=None, ocode=None):
+    """Drive the layout-neutral fold (ops.deep_fold) with a lax.scan
+    over window steps, in [N]-vec layout.
 
-    Pre-pass runs with trunc == W (attempt-everything) and consumes the
-    slot records + dense flags; replay runs with the resolved trunc and
-    consumes the committed cache/own-rows/counters. A scan (not a
-    static unroll) keeps the traced graph W-independent — in-loop
-    backedges are ~free on the bench device (PERF.md), while the
-    unrolled version's XLA compile time exploded with W.
-    """
+    Pre-pass: bad/ocode None (attempt-everything, no truncation);
+    replay: bad [N, Q] slot verdicts + ocode [N, S] own-lane codes.
+    Returns the final carry with list fields stacked back to arrays.
+    A scan keeps the traced graph W-independent (in-loop backedges are
+    ~free on the bench device, while an unrolled fold's XLA compile
+    time exploded with W)."""
     N, C, S = cfg.num_nodes, cfg.cache_size, 1 << cfg.block_bits
     W = cfg.drain_depth + cfg.txn_width
     Q = cfg.deep_slots
-    G = cfg.deep_ownerval_slots
-    INV = int(CacheState.INVALID)
-    MOD = int(CacheState.MODIFIED)
-    EXC = int(CacheState.EXCLUSIVE)
-    SHD = int(CacheState.SHARED)
-    D_U, D_S, D_EM = int(DirState.U), int(DirState.S), int(DirState.EM)
     rows = jnp.arange(N, dtype=jnp.int32)
-    c_iota = jnp.arange(C, dtype=jnp.int32)[None, :]
-    q_iota = jnp.arange(Q, dtype=jnp.int32)[None, :]
-    g_iota = jnp.arange(G, dtype=jnp.int32)[None, :]
-    s_iota = jnp.arange(S, dtype=jnp.int32)[None, :]
-    bmask = S - 1
-    zN = jnp.zeros((N,), jnp.int32)
+    zero = jnp.zeros((N,), jnp.int32)
+    false = jnp.zeros((N,), bool)
     dm_own = st.dm.reshape(N, S, DM_COLS)
-
-    carry0 = dict(
-        ca=st.cache_addr, cv=st.cache_val, cs=st.cache_state,
-        cv_src=jnp.full((N, C), -1, jnp.int32),
-        rrf=jnp.zeros((N, C), bool), wf=jnp.zeros((N, C), bool),
-        dms=dm_own[:, :, DM_STATE], dmc=dm_own[:, :, DM_COUNT],
-        dmo=dm_own[:, :, DM_OWNER], dmm=dm_own[:, :, DM_MEM],
-        dmm_src=jnp.full((N, S), -1, jnp.int32),
-        touched=jnp.zeros((N, S), bool),
-        act_acc=jnp.zeros((N, S), jnp.int32),
-        mark=jnp.zeros((N, S), bool),
-        poison=jnp.zeros((N, S), bool),
-        cv_req=st.cache_val,
-        cv_req_src=jnp.full((N, C), -1, jnp.int32),
-        stopped=jnp.zeros((N,), bool), frozen=jnp.zeros((N,), bool),
-        n_slot=zN, n_g=zN, seen_req=jnp.zeros((N,), bool),
-        n_ret=zN, rh=zN, wh=zN,
-        c_rd=zN, c_wr=zN, c_up=zN, c_ev=zN,
-        kind=jnp.zeros((N, Q), jnp.int32), ent=jnp.zeros((N, Q), jnp.int32),
-        sval=jnp.zeros((N, Q), jnp.int32),
-        pos=jnp.full((N, Q), W, jnp.int32),
-        rel=jnp.zeros((N, Q), bool), relv=jnp.zeros((N, Q), jnp.int32),
-        reld=jnp.zeros((N, Q), bool),
-        g_owner=jnp.zeros((N, G), jnp.int32),
-        g_ci=jnp.zeros((N, G), jnp.int32),
-        k=jnp.zeros((), jnp.int32),
-    )
-
+    carry0 = deep_fold.fold_carry0(
+        cfg,
+        ca=[st.cache_addr[:, i] for i in range(C)],
+        cv=[st.cache_val[:, i] for i in range(C)],
+        cs=[st.cache_state[:, i] for i in range(C)],
+        dm_rows=dict(
+            dms=[dm_own[:, s, DM_STATE] for s in range(S)],
+            dmc=[dm_own[:, s, DM_COUNT] for s in range(S)],
+            dmo=[dm_own[:, s, DM_OWNER] for s in range(S)],
+            dmm=[dm_own[:, s, DM_MEM] for s in range(S)]),
+        zero=zero, false=false)
+    badL = [zero] * Q if bad is None else [bad[:, q] for q in range(Q)]
+    ocodeL = ([zero] * S if ocode is None
+              else [ocode[:, s] for s in range(S)])
     horizon = st.horizon
 
     def body(c, x):
-        oa, val, live = x
-        k = c["k"]
-        live = live & (k < horizon)
-        # cache values as of the node's first fill-request attempt (and
-        # only committed writes can precede it in the replay pass):
-        # foreign requests read owner values from THIS snapshot, which
-        # keeps every value they observe inside the owner's pre-request
-        # stratum (module docstring)
-        cv_req = jnp.where(c["seen_req"][:, None], c["cv_req"], c["cv"])
-        cv_req_src = jnp.where(c["seen_req"][:, None], c["cv_req_src"],
-                               c["cv_src"])
-        op, addr = oa >> 28, oa & 0x0FFFFFFF
-        home = addr >> cfg.block_bits
-        block = addr & bmask
-        is_own = home == rows
-        ci = codec.cache_index(cfg, addr)
-        onehot = ci[:, None] == c_iota
-        l_addr, l_val, l_state, l_src, l_rrf_i, l_wf_i = _sel_s(
-            ci, c["ca"], c["cv"], c["cs"], c["cv_src"],
-            c["rrf"].astype(jnp.int32), c["wf"].astype(jnp.int32))
-        l_rrf, l_wf = l_rrf_i.astype(bool), l_wf_i.astype(bool)
-        tag_ok = (l_addr == addr) & (l_state != INV)
-        is_rd, is_wr = op == int(Op.READ), op == int(Op.WRITE)
-        rd_hit = live & is_rd & tag_ok
-        wr_hit = live & is_wr & tag_ok & ((l_state == MOD)
-                                          | (l_state == EXC))
-        wr_sh = live & is_wr & tag_ok & (l_state == SHD)
-        nop = live & (op == int(Op.NOP))
-        dep_stop = wr_sh & l_rrf               # v1: resolve next round
-        upg = wr_sh & ~l_rrf
-        rd_miss = live & is_rd & ~tag_ok
-        wr_miss = live & is_wr & ~tag_ok
-        is_txn = (upg | rd_miss | wr_miss) & ~dep_stop
-        hit = rd_hit | wr_hit | nop
+        oa, val, live, k = x
+        return deep_fold.fold_step(cfg, c, rows, oa, val, live, k,
+                                   horizon, badL, ocodeL), None
 
-        has_victim = is_txn & ~tag_ok & (l_state != INV) & (l_addr != addr)
-        v_block = l_addr & bmask
-        v_own = (l_addr >> cfg.block_bits) == rows
-        v_mod = l_state == MOD
-
-        own_txn = is_txn & is_own
-        rem_txn = is_txn & ~is_own
-        own_vic = has_victim & v_own
-        rem_vic = has_victim & ~v_own
-        probe = hit & c["frozen"] & ~is_own & ~l_wf
-
-        # --- own register reads ------------------------------------------
-        t_dms, t_dmc, t_dmo, t_dmm, t_dmm_src, t_act = _sel_s(
-            block, c["dms"], c["dmc"], c["dmo"], c["dmm"], c["dmm_src"],
-            c["act_acc"])
-        v_dmc, v_act = _sel_s(v_block, c["dmc"], c["act_acc"])
-
-        # --- stop conditions ---------------------------------------------
-        n_need = (rem_txn.astype(jnp.int32)
-                  + (rem_vic & ~(jnp.any(
-                      ((c["kind"] >= K_RD) & (c["kind"] <= K_UP))
-                      & (c["ent"] == l_addr[:, None]), axis=1)))
-                  .astype(jnp.int32)
-                  + probe.astype(jnp.int32))
-        over_q = (c["n_slot"] + n_need) > Q
-        # EM-with-unresolved-owner (a same-round promotion, owner == -1)
-        # composes via the row's memory: SHARED lines are clean in this
-        # protocol (every downgrade/flush writes memory), so a
-        # promoted-E line's value equals mem
-        t_em_o = (t_dms == D_EM) & (t_dmo != rows) & (t_dmo >= 0)
-        t_em_p = (t_dms == D_EM) & (t_dmo == -1)
-        t_em = t_em_o | t_em_p
-        g_need = own_txn & (rd_miss | wr_miss) & t_em_o
-        over_g = g_need & (c["n_g"] >= G)
-        is_remev = ((c["kind"] >= K_RD) & (c["kind"] <= K_EVM))
-        # release: displacing a line WE filled via an earlier window
-        # request composes the eviction into that request's slot (we
-        # hold the entry's lane, so the fill+evict net row commits as
-        # one write) instead of stopping the window
-        is_fill_slot = (c["kind"] >= K_RD) & (c["kind"] <= K_UP)
-        rel_hit = is_fill_slot & (c["ent"] == l_addr[:, None])   # [N, Q]
-        rel_any = jnp.any(rel_hit, axis=1) & rem_vic
-        dup = jnp.any(is_remev & (c["ent"] == addr[:, None]), axis=1) \
-            & rem_txn
-        dup = dup | (jnp.any(is_remev & (c["ent"] == l_addr[:, None]),
-                             axis=1) & rem_vic & ~rel_any)
-        stop_now = (~c["stopped"]) & (live & ~nop) & (
-            dep_stop | over_q | over_g | dup
-            | ~(hit | is_txn))
-        stop_now = stop_now | ((~c["stopped"]) & ~live)
-        act = ~c["stopped"] & ~stop_now & (hit | is_txn)
-        r = act & (k < trunc)                  # retired this step
-
-        own_txn &= act
-        rem_txn &= act
-        own_vic &= act
-        rem_vic &= act
-        probe &= act
-        g_take = g_need & act
-
-        # --- slot emission (attempt-based) -------------------------------
-        e_vic = jnp.clip(l_addr, 0, N * S - 1)
-        e_fill = jnp.clip(addr, 0, N * S - 1)
-        o1 = c["n_slot"]
-        rem_vic_slot = rem_vic & ~rel_any
-        o2 = o1 + rem_vic_slot.astype(jnp.int32)
-        kind, ent, sval, pos = c["kind"], c["ent"], c["sval"], c["pos"]
-        # gate by retirement, not attempt: in the replay pass a
-        # displacement past the truncation point must not release its
-        # fill slot (the fill would commit a net row for an eviction
-        # that never happened)
-        mrel = rel_hit & (rem_vic & (k < trunc))[:, None]
-        rel = c["rel"] | mrel
-        relv = jnp.where(mrel, l_val[:, None], c["relv"])
-        reld = c["reld"] | (mrel & v_mod[:, None])
-        m1 = rem_vic_slot[:, None] & (o1[:, None] == q_iota)
-        vic_kind = jnp.where(v_mod, K_EVM, K_EVS)
-        kind = jnp.where(m1, vic_kind[:, None], kind)
-        ent = jnp.where(m1, e_vic[:, None], ent)
-        sval = jnp.where(m1, l_val[:, None], sval)
-        pos = jnp.where(m1, k, pos)
-        fp = rem_txn | probe
-        m2 = fp[:, None] & (o2[:, None] == q_iota)
-        fill_kind = jnp.where(probe, K_PROBE,
-                              jnp.where(rd_miss, K_RD,
-                                        jnp.where(wr_miss, K_WR, K_UP)))
-        kind = jnp.where(m2, fill_kind[:, None], kind)
-        ent = jnp.where(m2, e_fill[:, None], ent)
-        slot_v = jnp.where(probe, c["seen_req"].astype(jnp.int32), val)
-        sval = jnp.where(m2, slot_v[:, None], sval)
-        pos = jnp.where(m2, k, pos)
-        n_slot = c["n_slot"] + jnp.where(act, n_need, 0)
-        seen_req = c["seen_req"] | rem_txn
-
-        # --- g-slot (own-EM owner value) ---------------------------------
-        g_sel = (g_iota == c["n_g"][:, None]) & g_take[:, None]
-        g_owner = jnp.where(g_sel, jnp.clip(t_dmo, 0, N - 1)[:, None],
-                            c["g_owner"])
-        g_ci = jnp.where(g_sel, ci[:, None], c["g_ci"])
-        g_id = c["n_g"]
-        n_g = c["n_g"] + g_take.astype(jnp.int32)
-
-        # --- counters ----------------------------------------------------
-        n_ret = c["n_ret"] + r
-        rh = c["rh"] + (rd_hit & r)
-        wh = c["wh"] + (wr_hit & r)
-        c_rd = c["c_rd"] + (rd_miss & r)
-        c_wr = c["c_wr"] + (wr_miss & r)
-        c_up = c["c_up"] + (upg & r)
-        c_ev = c["c_ev"] + (has_victim & r)
-
-        # --- hit write effects -------------------------------------------
-        wmask = (wr_hit & r)[:, None] & onehot
-        cv = jnp.where(wmask, val[:, None], c["cv"])
-        cv_src = jnp.where(wmask, -1, c["cv_src"])
-        cs = jnp.where(wmask, MOD, c["cs"])
-
-        # --- own victim composition --------------------------------------
-        vo = own_vic & r
-        ev_m = vo & v_mod
-        ev_e = vo & ~v_mod & (l_state == EXC)
-        ev_s = vo & ~v_mod & (l_state == SHD)
-        nvc = jnp.where(ev_s, v_dmc - 1, 0)
-        nvs = jnp.where(ev_s & (nvc >= 2), D_S,
-                        jnp.where(ev_s & (nvc == 1), D_EM, D_U))
-        promote = ev_s & (nvc == 1)
-        m2v = vo[:, None] & (v_block[:, None] == s_iota)
-        dms = jnp.where(m2v, nvs[:, None], c["dms"])
-        dmc = jnp.where(m2v, nvc[:, None], c["dmc"])
-        dmo = jnp.where(m2v & promote[:, None], -1, c["dmo"])
-        dmm = jnp.where(m2v & ev_m[:, None], l_val[:, None], c["dmm"])
-        dmm_src = jnp.where(m2v & ev_m[:, None], l_src[:, None],
-                            c["dmm_src"])
-        touched = c["touched"] | m2v
-        act_acc = jnp.where(
-            m2v, jnp.maximum(v_act, jnp.where(promote, ACT_PROMOTE,
-                                              ACT_NONE))[:, None],
-            c["act_acc"])
-        v_foreign = ev_s & (v_dmc > 1)
-        mark = c["mark"] | (m2v & v_foreign[:, None])
-        poison = c["poison"] | (m2v & c["seen_req"][:, None])
-
-        # --- own target composition --------------------------------------
-        to = own_txn & r
-        t_u_eff = (t_dms == D_U) | ((t_dms == D_EM) & (t_dmo == rows))
-        t_s = t_dms == D_S
-        o_rd, o_wr, o_up = to & rd_miss, to & wr_miss, to & upg
-        wlike = o_wr | o_up
-        nts = jnp.where(wlike | (o_rd & t_u_eff), D_EM, D_S)
-        ntc = jnp.where(wlike | (o_rd & t_u_eff), 1,
-                        jnp.where(o_rd & t_em, 2, t_dmc + 1))
-        nto = jnp.where(wlike | (o_rd & t_u_eff), rows, t_dmo)
-        flush = (o_rd | o_wr) & t_em_o
-        ntm_src = jnp.where(flush, g_id, t_dmm_src)
-        new_act = jnp.where(wlike & ~t_u_eff, ACT_KILL,
-                            jnp.where(o_rd & t_em, ACT_DOWN, ACT_NONE))
-        # touching a pending entry OVERRIDES the accumulated PROMOTE:
-        # promote-then-read nets a DOWNGRADE (the promotee may be an
-        # old E/M owner whose line the single composed action must
-        # still take to SHARED); promote-then-write kills it
-        act_override = to & t_em_p
-        m2t = to[:, None] & (block[:, None] == s_iota)
-        dms = jnp.where(m2t, nts[:, None], dms)
-        dmc = jnp.where(m2t, ntc[:, None], dmc)
-        dmo = jnp.where(m2t, nto[:, None], dmo)
-        dmm_src = jnp.where(m2t, ntm_src[:, None], dmm_src)
-        touched = touched | m2t
-        act_acc = jnp.where(
-            m2t, jnp.where(act_override,
-                           new_act, jnp.maximum(t_act, new_act))[:, None],
-            act_acc)
-        t_foreign = (t_s & (t_dmc > jnp.where(upg, 1, 0))) | t_em
-        mark = mark | (m2t & (to & t_foreign)[:, None])
-        poison = poison | (m2t & c["seen_req"][:, None])
-
-        # --- fills -------------------------------------------------------
-        fill = (own_txn | rem_txn) & r
-        fstate = jnp.where(is_wr, MOD,
-                           jnp.where(own_txn & t_u_eff, EXC, SHD))
-        f_val = jnp.where(is_wr, val, jnp.where(t_em_o, 0, t_dmm))
-        f_src = jnp.where(is_wr | ~is_own, -1,
-                          jnp.where(t_em_o, g_id, t_dmm_src))
-        fmask = fill[:, None] & onehot
-        ca = jnp.where(fmask, addr[:, None], c["ca"])
-        cv = jnp.where(fmask, f_val[:, None], cv)
-        cv_src = jnp.where(fmask, f_src[:, None], cv_src)
-        cs = jnp.where(fmask, fstate[:, None], cs)
-        rrf = jnp.where(fmask, (rem_txn & rd_miss)[:, None], c["rrf"])
-        wf = jnp.where(fmask, True, c["wf"])
-
-        frozen = c["frozen"] | (is_txn & ~c["stopped"] & ~stop_now)
-        stopped = c["stopped"] | stop_now
-        # yield records (resolved post-scatter against the own-slice
-        # lane): a chain TXN touch of an own entry yields to any fresh
-        # eviction notice there (at any position — notices never
-        # compose on touched rows) and to fresh fill requests when the
-        # touch sits after the node's own first fill-request attempt
-        # (the acyclicity rule); own-entry HITS after the first request
-        # yield to fresh fill requests only (notices never hurt a hit).
-        # The stratum bit rides in bit 16 of the block record (block
-        # indices are block_bits <= 16 wide; config enforces the cap).
-        post = c["seen_req"].astype(jnp.int32) << 16
-        y_t = jnp.where(own_txn, block | post, -1)
-        y_v = jnp.where(own_vic, v_block | post, -1)
-        y_h = jnp.where(act & is_own & (rd_hit | wr_hit)
-                        & c["seen_req"], block, -1)
-        out = dict(ca=ca, cv=cv, cs=cs, cv_src=cv_src, rrf=rrf, wf=wf,
-                   dms=dms, dmc=dmc, dmo=dmo, dmm=dmm, dmm_src=dmm_src,
-                   touched=touched, act_acc=act_acc,
-                   mark=mark, poison=poison, stopped=stopped,
-                   frozen=frozen, n_slot=n_slot, n_g=n_g,
-                   seen_req=seen_req, n_ret=n_ret, rh=rh, wh=wh,
-                   c_rd=c_rd, c_wr=c_wr, c_up=c_up, c_ev=c_ev,
-                   kind=kind, ent=ent, sval=sval, pos=pos,
-                   rel=rel, relv=relv, reld=reld,
-                   g_owner=g_owner, g_ci=g_ci, cv_req=cv_req,
-                   cv_req_src=cv_req_src, k=k + 1)
-        return out, (y_t, y_v, y_h)
-
-    xs = (w_oa.T, w_val.T, w_live.T)
-    fin, (y_t, y_v, y_h) = jax.lax.scan(body, carry0, xs, length=W)
-    fin["cnt"] = dict(rd_miss=fin["c_rd"], wr_miss=fin["c_wr"],
+    xs = (w_oa.T, w_val.T, w_live.T, jnp.arange(W, dtype=jnp.int32))
+    fin, _ = jax.lax.scan(body, carry0, xs, length=W)
+    out = dict(fin)
+    for f in ("ca", "cv", "cs", "cv_src", "rrf", "wf", "cv_req",
+              "cv_req_src", "dms", "dmc", "dmo", "dmm", "dmm_src",
+              "touched", "act_acc", "mark", "poison", "kind", "ent",
+              "sval", "pos", "comm", "rel", "relv", "reld", "g_owner",
+              "g_ci"):
+        out[f] = jnp.stack(fin[f], axis=1)
+    out["cnt"] = dict(rd_miss=fin["c_rd"], wr_miss=fin["c_wr"],
                       upg=fin["c_up"], ev=fin["c_ev"])
-    fin["y_t"], fin["y_v"], fin["y_h"] = y_t, y_v, y_h   # [W, N]
-    return fin
+    return out
 
 
 def round_step_deep(cfg: SystemConfig, st: SyncState) -> SyncState:

@@ -1,0 +1,344 @@
+"""The deep-window fold, layout-neutral: one source for XLA and Pallas.
+
+Every per-node scalar is a "vec" — shape [N] under the XLA engine, a
+[1, T] lane-tile row inside the Pallas kernels — and every per-node
+table ([N, S] own-directory slice, [N, C] cache, [N, Q] slots) is a
+python LIST of vecs. All array code below is elementwise on vecs plus
+where-chains over lists, so the identical function traces correctly in
+both layouts; `ops.deep_engine` drives it with jax.lax.scan over window
+steps, `ops.pallas_deep` with an in-kernel fori_loop.
+
+Truncation is computed *inside* the fold: the replay pass receives the
+per-slot badness verdicts (lane losses and priority aborts, from the
+XLA middle section) and the dense own-lane codes, and stops retirement
+at the first bad slot or yield-unsafe own touch. The pre-pass passes
+zeros for both, which disables truncation (attempt-everything).
+
+Protocol semantics and the serialization argument live in
+ops/deep_engine's module docstring.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState, Op
+
+# slot kinds (remote events): fill requests, eviction notices, probes
+K_NONE, K_RD, K_WR, K_UP, K_EVS, K_EVM, K_PROBE = 0, 1, 2, 3, 4, 5, 6
+
+# dense own-lane code bits (XLA middle section -> replay fold): entry
+# has a fresh foreign lane key / that key is an eviction notice / its
+# sender's priority beats ours
+OC_FRESH, OC_EV, OC_BEATS = 1, 2, 4
+
+# fan-out actions, packed into DM_ACT as (round << 4)|(act_h << 2)|act_o
+ACT_NONE, ACT_DOWN, ACT_KILL, ACT_PROMOTE = 0, 1, 2, 3
+
+
+def _sel(lst, idx):
+    """where-chain select: lst[idx] per node, idx a vec of list indices."""
+    out = lst[0]
+    for i in range(1, len(lst)):
+        out = jnp.where(idx == i, lst[i], out)
+    return out
+
+
+def _upd(lst, idx, mask, val):
+    """lst[idx] = val where mask, per node."""
+    return [jnp.where(mask & (idx == i), val, r) for i, r in enumerate(lst)]
+
+
+def fold_carry0(cfg: SystemConfig, ca, cv, cs, dm_rows, zero, false):
+    """Initial fold carry. ca/cv/cs: C-lists of vecs; dm_rows: dict of
+    S-lists (dms/dmc/dmo/dmm); zero/false: a zero int vec / false vec
+    in the target layout."""
+    C, S = cfg.cache_size, 1 << cfg.block_bits
+    Q, G = cfg.deep_slots, cfg.deep_ownerval_slots
+    neg1 = zero - 1
+    W = cfg.drain_depth + cfg.txn_width
+    return dict(
+        ca=list(ca), cv=list(cv), cs=list(cs),
+        cv_src=[neg1] * C, rrf=[false] * C, wf=[false] * C,
+        dms=list(dm_rows["dms"]), dmc=list(dm_rows["dmc"]),
+        dmo=list(dm_rows["dmo"]), dmm=list(dm_rows["dmm"]),
+        dmm_src=[neg1] * S,
+        touched=[false] * S, act_acc=[zero] * S,
+        mark=[false] * S, poison=[false] * S,
+        cv_req=list(cv), cv_req_src=[neg1] * C,
+        stopped=false, frozen=false, truncated=false,
+        n_slot=zero, n_g=zero, seen_req=false,
+        n_ret=zero, rh=zero, wh=zero,
+        c_rd=zero, c_wr=zero, c_up=zero, c_ev=zero,
+        kind=[zero] * Q, ent=[zero] * Q, sval=[zero] * Q,
+        pos=[zero + W] * Q, comm=[false] * Q,
+        rel=[false] * Q, relv=[zero] * Q, reld=[false] * Q,
+        g_owner=[zero] * G, g_ci=[zero] * G,
+    )
+
+
+def fold_step(cfg: SystemConfig, c, node, oa, val, live, k, horizon,
+              bad, ocode):
+    """One window step. c: carry dict (lists of vecs); node: vec of node
+    ids; oa/val/live: this step's instruction; k: int step index;
+    horizon: attempt-cap vec; bad: Q-list of slot-badness vecs (zeros in
+    the pre-pass); ocode: S-list of own-lane code vecs (zeros in the
+    pre-pass). Returns the next carry."""
+    C, S = cfg.cache_size, 1 << cfg.block_bits
+    Q, G = cfg.deep_slots, cfg.deep_ownerval_slots
+    INV = int(CacheState.INVALID)
+    MOD = int(CacheState.MODIFIED)
+    EXC = int(CacheState.EXCLUSIVE)
+    SHD = int(CacheState.SHARED)
+    D_U, D_S, D_EM = int(DirState.U), int(DirState.S), int(DirState.EM)
+    bmask = S - 1
+
+    live = live & (k < horizon)
+    # cache values as of the node's first fill-request attempt: foreign
+    # requests read owner values from THIS snapshot, which keeps every
+    # observed value inside the owner's pre-request stratum
+    cv_req = [jnp.where(c["seen_req"], rq, v)
+              for rq, v in zip(c["cv_req"], c["cv"])]
+    cv_req_src = [jnp.where(c["seen_req"], rq, v)
+                  for rq, v in zip(c["cv_req_src"], c["cv_src"])]
+    op, addr = oa >> 28, oa & 0x0FFFFFFF
+    home = addr >> cfg.block_bits
+    block = addr & bmask
+    is_own = home == node
+    ci = block % C           # direct-mapped (codec.cache_index)
+    l_addr = _sel(c["ca"], ci)
+    l_val = _sel(c["cv"], ci)
+    l_state = _sel(c["cs"], ci)
+    l_src = _sel(c["cv_src"], ci)
+    l_rrf = _sel(c["rrf"], ci)
+    l_wf = _sel(c["wf"], ci)
+    tag_ok = (l_addr == addr) & (l_state != INV)
+    is_rd, is_wr = op == int(Op.READ), op == int(Op.WRITE)
+    rd_hit = live & is_rd & tag_ok
+    wr_hit = live & is_wr & tag_ok & ((l_state == MOD) | (l_state == EXC))
+    wr_sh = live & is_wr & tag_ok & (l_state == SHD)
+    nop = live & (op == int(Op.NOP))
+    dep_stop = wr_sh & l_rrf                  # v1: resolve next round
+    upg = wr_sh & ~l_rrf
+    rd_miss = live & is_rd & ~tag_ok
+    wr_miss = live & is_wr & ~tag_ok
+    is_txn = (upg | rd_miss | wr_miss) & ~dep_stop
+    hit = rd_hit | wr_hit | nop
+
+    has_victim = is_txn & ~tag_ok & (l_state != INV) & (l_addr != addr)
+    v_block = l_addr & bmask
+    v_own = (l_addr >> cfg.block_bits) == node
+    v_mod = l_state == MOD
+
+    own_txn = is_txn & is_own
+    rem_txn = is_txn & ~is_own
+    own_vic = has_victim & v_own
+    rem_vic = has_victim & ~v_own
+    probe = hit & c["frozen"] & ~is_own & ~l_wf
+
+    # --- own register reads ----------------------------------------------
+    t_dms = _sel(c["dms"], block)
+    t_dmc = _sel(c["dmc"], block)
+    t_dmo = _sel(c["dmo"], block)
+    t_dmm = _sel(c["dmm"], block)
+    t_dmm_src = _sel(c["dmm_src"], block)
+    t_act = _sel(c["act_acc"], block)
+    v_dmc = _sel(c["dmc"], v_block)
+    v_act = _sel(c["act_acc"], v_block)
+
+    # --- stop conditions ---------------------------------------------------
+    rel_hit = [((kk >= K_RD) & (kk <= K_UP)) & (ee == l_addr)
+               for kk, ee in zip(c["kind"], c["ent"])]
+    rel_any_all = rel_hit[0]
+    for rh_ in rel_hit[1:]:
+        rel_any_all = rel_any_all | rh_
+    rel_any = rel_any_all & rem_vic
+    dup_t = dup_v = rel_hit[0] & False
+    for kk, ee in zip(c["kind"], c["ent"]):
+        isrem = (kk >= K_RD) & (kk <= K_EVM)
+        dup_t = dup_t | (isrem & (ee == addr))
+        dup_v = dup_v | (isrem & (ee == l_addr))
+    dup = (dup_t & rem_txn) | (dup_v & rem_vic & ~rel_any)
+    n_need = (rem_txn.astype(jnp.int32)
+              + (rem_vic & ~rel_any_all).astype(jnp.int32)
+              + probe.astype(jnp.int32))
+    over_q = (c["n_slot"] + n_need) > Q
+    # EM-with-unresolved-owner (same-round promotion, owner == -1)
+    # composes via the row's memory: SHARED lines are clean in this
+    # protocol, so a promoted-E line's value equals mem
+    t_em_o = (t_dms == D_EM) & (t_dmo != node) & (t_dmo >= 0)
+    t_em_p = (t_dms == D_EM) & (t_dmo == -1)
+    t_em = t_em_o | t_em_p
+    g_need = own_txn & (rd_miss | wr_miss) & t_em_o
+    over_g = g_need & (c["n_g"] >= G)
+    stop_now = (~c["stopped"]) & (live & ~nop) & (
+        dep_stop | over_q | over_g | dup | ~(hit | is_txn))
+    stop_now = stop_now | ((~c["stopped"]) & ~live)
+    act = ~c["stopped"] & ~stop_now & (hit | is_txn)
+
+    # --- truncation (replay only; pre-pass gets zero bad/ocode) ------------
+    o1 = c["n_slot"]
+    o2 = o1 + (rem_vic & ~rel_any_all).astype(jnp.int32)
+    bad1 = _sel(bad, o1)
+    bad2 = _sel(bad, o2)
+    slot_bad = ((rem_vic & ~rel_any_all) & act & (bad1 != 0)) \
+        | ((rem_txn | probe) & act & (bad2 != 0))
+    # chain-yield checks against the own-lane codes: a chain TXN touch
+    # yields to a winning fresh notice at any position and to any
+    # winning fresh event after our first fill-request attempt; own
+    # hits after the first request yield to fresh fill requests
+    tc = _sel(ocode, block)
+    vc = _sel(ocode, v_block)
+    post = c["seen_req"]
+    y_bad = own_txn & ((((tc & OC_EV) != 0) & ((tc & OC_BEATS) != 0))
+                       | (post & ((tc & OC_FRESH) != 0)
+                          & ((tc & OC_BEATS) != 0)))
+    y_bad = y_bad | (own_vic
+                     & ((((vc & OC_EV) != 0) & ((vc & OC_BEATS) != 0))
+                        | (post & ((vc & OC_FRESH) != 0)
+                           & ((vc & OC_BEATS) != 0))))
+    y_bad = y_bad | ((rd_hit | wr_hit) & is_own & post
+                     & ((tc & OC_FRESH) != 0) & ((tc & OC_EV) == 0))
+    truncated = c["truncated"] | ((slot_bad | y_bad) & act)
+    r = act & ~truncated
+
+    own_txn_a, rem_txn_a = own_txn & act, rem_txn & act
+    own_vic_a, rem_vic_a = own_vic & act, rem_vic & act
+    probe_a = probe & act
+    g_take = g_need & act
+    own_txn_r = own_txn & r
+    own_vic_r = own_vic & r
+    fill_r = (own_txn | rem_txn) & r
+
+    # --- slot emission (attempt-based) -------------------------------------
+    rem_vic_slot = rem_vic_a & ~rel_any_all
+    kind, ent, sval, pos = c["kind"], c["ent"], c["sval"], c["pos"]
+    comm = c["comm"]
+    # release marking is retirement-gated: a displacement past the
+    # truncation point must not release its fill slot
+    mrel_m = rem_vic & r
+    rel = [rr | (rh_ & mrel_m) for rr, rh_ in zip(c["rel"], rel_hit)]
+    relv = [jnp.where(rh_ & mrel_m, l_val, rv)
+            for rv, rh_ in zip(c["relv"], rel_hit)]
+    reld = [rd_ | (rh_ & mrel_m & v_mod)
+            for rd_, rh_ in zip(c["reld"], rel_hit)]
+    vic_kind = jnp.where(v_mod, K_EVM, K_EVS)
+    kind = _upd(kind, o1, rem_vic_slot, vic_kind)
+    ent = _upd(ent, o1, rem_vic_slot, jnp.clip(l_addr, 0, None))
+    sval = _upd(sval, o1, rem_vic_slot, l_val)
+    pos = _upd(pos, o1, rem_vic_slot, jnp.zeros_like(o1) + k)
+    comm = _upd(comm, o1, rem_vic_slot & r, jnp.bool_(True) & r)
+    fp = rem_txn_a | probe_a
+    fill_kind = jnp.where(probe, K_PROBE,
+                          jnp.where(rd_miss, K_RD,
+                                    jnp.where(wr_miss, K_WR, K_UP)))
+    slot_v = jnp.where(probe, c["seen_req"].astype(jnp.int32), val)
+    kind = _upd(kind, o2, fp, fill_kind)
+    ent = _upd(ent, o2, fp, jnp.clip(addr, 0, None))
+    sval = _upd(sval, o2, fp, slot_v)
+    pos = _upd(pos, o2, fp, jnp.zeros_like(o2) + k)
+    comm = _upd(comm, o2, (rem_txn_a & r), jnp.bool_(True) & r)
+    n_slot = c["n_slot"] + jnp.where(act, n_need, 0)
+    seen_req = c["seen_req"] | rem_txn_a
+
+    # --- g-slot (own-EM owner value) ---------------------------------------
+    g_owner = _upd(c["g_owner"], c["n_g"], g_take,
+                   jnp.clip(t_dmo, 0, None))
+    g_ci = _upd(c["g_ci"], c["n_g"], g_take, ci)
+    g_id = c["n_g"]
+    n_g = c["n_g"] + g_take.astype(jnp.int32)
+
+    # --- counters ----------------------------------------------------------
+    n_ret = c["n_ret"] + r
+    rh = c["rh"] + (rd_hit & r)
+    wh = c["wh"] + (wr_hit & r)
+    c_rd = c["c_rd"] + (rd_miss & r)
+    c_wr = c["c_wr"] + (wr_miss & r)
+    c_up = c["c_up"] + (upg & r)
+    c_ev = c["c_ev"] + (has_victim & r)
+
+    # --- hit write effects -------------------------------------------------
+    wm = wr_hit & r
+    cv = _upd(c["cv"], ci, wm, val)
+    cv_src = _upd(c["cv_src"], ci, wm, jnp.zeros_like(val) - 1)
+    cs = _upd(c["cs"], ci, wm, jnp.zeros_like(val) + MOD)
+
+    # --- own victim composition --------------------------------------------
+    vo = own_vic_r
+    ev_m = vo & v_mod
+    ev_s = vo & ~v_mod & (l_state == SHD)
+    nvc = jnp.where(ev_s, v_dmc - 1, 0)
+    nvs = jnp.where(ev_s & (nvc >= 2), D_S,
+                    jnp.where(ev_s & (nvc == 1), D_EM, D_U))
+    promote = ev_s & (nvc == 1)
+    dms = _upd(c["dms"], v_block, vo, nvs)
+    dmc = _upd(c["dmc"], v_block, vo, nvc)
+    dmo = _upd(c["dmo"], v_block, vo & promote, jnp.zeros_like(nvc) - 1)
+    dmm = _upd(c["dmm"], v_block, ev_m, l_val)
+    dmm_src = _upd(c["dmm_src"], v_block, ev_m, l_src)
+    touched = _upd(c["touched"], v_block, vo, jnp.bool_(True) & vo)
+    act_acc = _upd(c["act_acc"], v_block, vo,
+                   jnp.maximum(v_act, jnp.where(promote, ACT_PROMOTE,
+                                                ACT_NONE)))
+    v_foreign = ev_s & (v_dmc > 1)
+    mark = _upd(c["mark"], v_block, vo & v_foreign, jnp.bool_(True))
+    poison = _upd(c["poison"], v_block, vo & c["seen_req"],
+                  jnp.bool_(True))
+
+    # --- own target composition --------------------------------------------
+    to = own_txn_r
+    t_u_eff = (t_dms == D_U) | ((t_dms == D_EM) & (t_dmo == node))
+    t_s = t_dms == D_S
+    o_rd, o_wr, o_up = to & rd_miss, to & wr_miss, to & upg
+    wlike = o_wr | o_up
+    nts = jnp.where(wlike | (o_rd & t_u_eff), D_EM, D_S)
+    ntc = jnp.where(wlike | (o_rd & t_u_eff), 1,
+                    jnp.where(o_rd & t_em, 2, t_dmc + 1))
+    nto = jnp.where(wlike | (o_rd & t_u_eff), node, t_dmo)
+    flush = (o_rd | o_wr) & t_em_o
+    ntm_src = jnp.where(flush, g_id, t_dmm_src)
+    new_act = jnp.where(wlike & ~t_u_eff, ACT_KILL,
+                        jnp.where(o_rd & t_em, ACT_DOWN, ACT_NONE))
+    # touching a pending entry overrides the accumulated PROMOTE
+    act_override = to & t_em_p
+    dms = _upd(dms, block, to, nts)
+    dmc = _upd(dmc, block, to, ntc)
+    dmo = _upd(dmo, block, to, nto)
+    dmm_src = _upd(dmm_src, block, to, ntm_src)
+    touched = _upd(touched, block, to, jnp.bool_(True) & to)
+    act_acc = _upd(act_acc, block, to,
+                   jnp.where(act_override, new_act,
+                             jnp.maximum(t_act, new_act)))
+    t_foreign = (t_s & (t_dmc > jnp.where(upg, 1, 0))) | t_em
+    mark = _upd(mark, block, to & t_foreign, jnp.bool_(True))
+    poison = _upd(poison, block, to & c["seen_req"], jnp.bool_(True))
+
+    # --- fills -------------------------------------------------------------
+    fstate = jnp.where(is_wr, MOD,
+                       jnp.where(own_txn & t_u_eff, EXC, SHD))
+    f_val = jnp.where(is_wr, val, jnp.where(t_em_o, 0, t_dmm))
+    f_src = jnp.where(is_wr | ~is_own, -1,
+                      jnp.where(t_em_o, g_id, t_dmm_src))
+    ca = _upd(c["ca"], ci, fill_r, addr)
+    cv = _upd(cv, ci, fill_r, f_val)
+    cv_src = _upd(cv_src, ci, fill_r, f_src)
+    cs = _upd(cs, ci, fill_r, fstate)
+    rrf = [jnp.where(fill_r & (ci == i), rem_txn & rd_miss, x)
+           for i, x in enumerate(c["rrf"])]
+    wf = [jnp.where(fill_r & (ci == i), True, x)
+          for i, x in enumerate(c["wf"])]
+
+    frozen = c["frozen"] | (is_txn & ~c["stopped"] & ~stop_now)
+    stopped = c["stopped"] | stop_now
+    return dict(ca=ca, cv=cv, cs=cs, cv_src=cv_src, rrf=rrf, wf=wf,
+                dms=dms, dmc=dmc, dmo=dmo, dmm=dmm, dmm_src=dmm_src,
+                touched=touched, act_acc=act_acc, mark=mark,
+                poison=poison, cv_req=cv_req, cv_req_src=cv_req_src,
+                stopped=stopped, frozen=frozen, truncated=truncated,
+                n_slot=n_slot, n_g=n_g, seen_req=seen_req,
+                n_ret=n_ret, rh=rh, wh=wh,
+                c_rd=c_rd, c_wr=c_wr, c_up=c_up, c_ev=c_ev,
+                kind=kind, ent=ent, sval=sval, pos=pos, comm=comm,
+                rel=rel, relv=relv, reld=reld,
+                g_owner=g_owner, g_ci=g_ci)
