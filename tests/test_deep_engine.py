@@ -128,3 +128,31 @@ def test_golden_parity_deterministic_suites(suite, tmp_path):
         want = open(f"{REFERENCE_TESTS}/{suite}/core_{n}_output.txt",
                     "rb").read().decode()
         assert dumps[n] == want, f"{suite} core_{n} diverges"
+
+
+def test_event_tracing_matches_program_order():
+    """with_events retirement records: per-node projections are exact
+    program-order prefixes of the procedural stream, and the total
+    retired count matches the metrics (utils.eventlog contract)."""
+    from ue22cs343bb1_openmp_assignment_tpu.procedural import (
+        procedural_instr)
+    cfg = deep_cfg(8, 500, seed=3)
+    st = se.procedural_state(cfg, 24)
+    final, events = se.run_rounds_traced(cfg, st, 30)
+    ret = np.asarray(events["retired"])          # [rounds, N, W]
+    op = np.asarray(events["op"])
+    addr = np.asarray(events["addr"])
+    total = int(ret.sum())
+    assert total == int(final.metrics.instrs_retired)
+    import jax.numpy as jnp
+    for n in range(cfg.num_nodes):
+        got = [(int(o), int(a))
+               for t in range(ret.shape[0])
+               for k in range(ret.shape[2])
+               for o, a in [(op[t, n, k], addr[t, n, k])]
+               if ret[t, n, k]]
+        idxs = jnp.arange(len(got), dtype=jnp.int32)
+        oa, _ = procedural_instr(cfg, jnp.full_like(idxs, n), idxs)
+        want = [(int(x) >> 28, int(x) & 0x0FFFFFFF)
+                for x in np.asarray(oa)]
+        assert got == want, f"node {n}: traced order != program order"

@@ -11,6 +11,7 @@ from __future__ import annotations
 import ctypes
 import hashlib
 import os
+import shlex
 import subprocess
 import threading
 from typing import Optional, Sequence
@@ -23,8 +24,8 @@ _CXX = os.environ.get("CXX", "g++")
 # CXXFLAGS env overrides, as the Makefile's `CXXFLAGS ?=` did; the flag
 # string participates in the cache key, so sanitizer/debug builds get
 # their own cached library instead of silently reusing the default one
-_CXXFLAGS = os.environ.get(
-    "CXXFLAGS", "-O2 -std=c++17 -fPIC -Wall -Wextra").split()
+_CXXFLAGS = shlex.split(os.environ.get(
+    "CXXFLAGS", "-O2 -std=c++17 -fPIC -Wall -Wextra"))
 _lock = threading.Lock()
 _lib = None
 
@@ -52,9 +53,13 @@ def _lib_path() -> str:
 def _build(lib_path: str) -> None:
     os.makedirs(os.path.dirname(lib_path), exist_ok=True)
     tmp = lib_path + f".tmp{os.getpid()}"
-    subprocess.run([_CXX] + _CXXFLAGS + ["-shared", "-o", tmp, _SRC],
-                   check=True)
-    os.replace(tmp, lib_path)   # atomic: concurrent builders both win
+    try:
+        subprocess.run([_CXX] + _CXXFLAGS + ["-shared", "-o", tmp, _SRC],
+                       check=True)
+        os.replace(tmp, lib_path)   # atomic: concurrent builders both win
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def load_library() -> ctypes.CDLL:

@@ -109,8 +109,6 @@ the window.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 
@@ -118,6 +116,7 @@ from ue22cs343bb1_openmp_assignment_tpu import codec
 from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
 from ue22cs343bb1_openmp_assignment_tpu.procedural import procedural_instr
 from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState, Op
+from ue22cs343bb1_openmp_assignment_tpu.ops import deep_fold
 from ue22cs343bb1_openmp_assignment_tpu.ops.sync_engine import (
     DM_ACT, DM_CLAIM, DM_COLS, DM_COUNT, DM_MEM, DM_OWNER, DM_REQ,
     DM_STATE, SyncState, _round_key, claim_max_rounds)
@@ -189,8 +188,22 @@ def _fold_deep(cfg: SystemConfig, st: SyncState, w_oa, w_val, w_live,
     return out
 
 
-def round_step_deep(cfg: SystemConfig, st: SyncState) -> SyncState:
-    """One deep-window round. See module docstring for the design."""
+def round_step_deep(cfg: SystemConfig, st: SyncState,
+                    with_events: bool = False,
+                    return_stats: bool = False):
+    """One deep-window round. See module docstring for the design.
+
+    ``with_events=True`` additionally returns the round's retirement
+    record — per-node, per-window-step (op, addr, value, retired), the
+    same contract as ``_round_step_multi`` — and the return becomes
+    ``(state, events)``. The retired stream is always a program-order
+    prefix (module docstring), so the record is simply the first
+    ``n_ret`` window steps.
+
+    ``return_stats=True`` instead returns ``(state, stats)`` with the
+    round's anatomy as scalar sums (attempted/committed slots by kind,
+    lane losses, priority aborts, truncated/stopped node counts) — the
+    measurement surface behind scripts/prof_deepstats.py."""
     N, C, S = cfg.num_nodes, cfg.cache_size, 1 << cfg.block_bits
     E = N * S
     W = cfg.drain_depth + cfg.txn_width
@@ -216,10 +229,8 @@ def round_step_deep(cfg: SystemConfig, st: SyncState) -> SyncState:
         w_oa, w_val = w[..., 0], w[..., 1]
 
     # ---- pre-pass fold (attempt everything) ------------------------------
-    pre = _fold_deep(cfg, st, w_oa, w_val, w_live,
-                     jnp.full((N,), W, jnp.int32))
-    kind, ent, sval, pos = (pre["kind"], pre["ent"], pre["sval"],
-                            pre["pos"])
+    pre = _fold_deep(cfg, st, w_oa, w_val, w_live)
+    kind, ent, sval = pre["kind"], pre["ent"], pre["sval"]
     is_req = (kind == K_RD) | (kind == K_WR) | (kind == K_UP)
     is_ev = (kind == K_EVS) | (kind == K_EVM)
     is_probe = kind == K_PROBE
@@ -240,12 +251,13 @@ def round_step_deep(cfg: SystemConfig, st: SyncState) -> SyncState:
     dm_claimed = st.dm.at[lane_idx, DM_CLAIM].min(
         key_q.reshape(-1), mode="drop")
 
-    # ---- gathers: lane-back + dense home flags ---------------------------
+    # ---- gathers: lane-back + dense home flags (ONE fused gather) --------
     safe_ent = jnp.clip(ent, 0, E - 1)
-    lane_got = dm_claimed[safe_ent, DM_CLAIM]                # [N, Q]
     flags_arr = (pre["mark"].astype(jnp.int32) * F_MARK
                  + pre["poison"].astype(jnp.int32) * F_POISON).reshape(E)
-    got_flags = flags_arr[safe_ent]                          # [N, Q]
+    side = jnp.stack([dm_claimed[:, DM_CLAIM], flags_arr], axis=-1)
+    got2 = side[safe_ent]                                    # [N, Q, 2]
+    lane_got, got_flags = got2[..., 0], got2[..., 1]
 
     # ---- truncation ------------------------------------------------------
     # fresh lane keys this round sit strictly below every stale key (the
@@ -278,44 +290,29 @@ def round_step_deep(cfg: SystemConfig, st: SyncState) -> SyncState:
     # eviction notices never endanger a hit
     probe_bad = is_probe & (((got_flags & F_MARK) != 0)
                             | ((sval != 0) & lane_fresh & ~lane_is_ev))
-    bad = req_bad | ev_bad | probe_bad
-    trunc = jnp.min(jnp.where(bad, pos, W), axis=1)          # [N]
-    # chain-yield rule (dense own-slice reads — own entries are never
-    # our own lane targets, so any fresh key there is foreign): a chain
-    # TXN touch yields to a fresh notice at any position and to a fresh
-    # fill request after our first request attempt; post-request own
-    # HITS yield to fresh fill requests
+    bad = (req_bad | ev_bad | probe_bad).astype(jnp.int32)   # [N, Q]
+    # chain-yield codes (dense own-slice reads — own entries are never
+    # our own lane targets, so any fresh key there is foreign). The
+    # yield rules themselves run inside the replay fold
+    # (deep_fold.fold_step, the y_bad section): a chain TXN touch
+    # yields to a winning fresh notice at any position and to a winning
+    # fresh fill request after our first request attempt; post-request
+    # own HITS yield to fresh fill requests.
     own_lane = dm_claimed.reshape(N, S, DM_COLS)[:, :, DM_CLAIM]
     o_fresh = own_lane < thresh                              # [N, S]
     o_ev = (own_lane & 1) == 1
     o_beats = ((own_lane >> 1) & pmask) < prio_self[:, None]  # sender wins
-    # per-entry code: 1 = fresh, 2 = fresh EV, 4 = fresh & sender beats
-    # the home's priority
-    o_code = (o_fresh.astype(jnp.int32)
-              | (o_fresh & o_ev).astype(jnp.int32) * 2
-              | (o_fresh & o_beats).astype(jnp.int32) * 4)   # [N, S]
-    for k in range(W):
-        unsafe = jnp.zeros((N,), bool)
-        for y in (pre["y_t"][k], pre["y_v"][k]):
-            blockk = jnp.clip(y & 0xFFFF, 0, S - 1)
-            post = (y >= 0) & ((y >> 16) & 1).astype(bool)
-            code = _sel_s(blockk, o_code)[0]
-            fresh_ev = (code & 2) == 2
-            beats = (code & 4) == 4
-            # chain TXN touches: yield to a winning fresh notice at any
-            # position; after our first fill request, yield to any
-            # winning fresh event
-            unsafe |= (y >= 0) & beats & (fresh_ev | post)
-        yh = pre["y_h"][k]
-        code = _sel_s(jnp.clip(yh, 0, S - 1), o_code)[0]
-        # post-request own hits always defer to a fresh fill request
-        # (the request may kill this line; hits probe no lane, so the
-        # conservative side is ours). Notices never hurt a hit.
-        unsafe |= (yh >= 0) & ((code & 1) == 1) & ((code & 2) == 0)
-        trunc = jnp.minimum(trunc, jnp.where(unsafe, k, W))
+    # per-entry code bits, deep_fold.OC_*: 1 = fresh, 2 = fresh EV,
+    # 4 = fresh & sender beats the home's priority
+    o_code = (o_fresh.astype(jnp.int32) * deep_fold.OC_FRESH
+              | (o_fresh & o_ev).astype(jnp.int32) * deep_fold.OC_EV
+              | (o_fresh & o_beats).astype(jnp.int32)
+              * deep_fold.OC_BEATS)                          # [N, S]
 
     # ---- replay fold (committed prefix) ----------------------------------
-    rp = _fold_deep(cfg, st, w_oa, w_val, w_live, trunc)
+    # the fold truncates retirement at the first bad slot or
+    # yield-unsafe own touch; rp["comm"] marks the slots that committed
+    rp = _fold_deep(cfg, st, w_oa, w_val, w_live, bad=bad, ocode=o_code)
 
     # ---- dense merge of own rows -----------------------------------------
     rtag = st.round << 4
@@ -352,7 +349,7 @@ def round_step_deep(cfg: SystemConfig, st: SyncState) -> SyncState:
     dm = merged
 
     # ---- request composition (post-merge, per committed slot) ------------
-    commit = (is_req | is_ev) & won & (pos < trunc[:, None])
+    commit = (is_req | is_ev) & won & rp["comm"]
     g_rows = dm[safe_ent]                                    # [N, Q, cols]
     r_state = g_rows[..., DM_STATE]
     r_cnt = g_rows[..., DM_COUNT]
@@ -463,12 +460,20 @@ def round_step_deep(cfg: SystemConfig, st: SyncState) -> SyncState:
         cv_c = jnp.where(oh, fill_val[:, q][:, None], cv_c)
 
     # ---- fan-out ---------------------------------------------------------
+    # act + req pack into ONE dense [E] column (bit 20 = fresh, bits
+    # 16-19 = act nibble, bits 0-15 = requester id; num_nodes <= 65536
+    # by the deep-window address-width cap), so the per-line gather
+    # reads 1 column instead of the 7-column row
     line_e = jnp.clip(ca_c, 0, E - 1)
-    line_dm = dm[line_e]                                     # [N, C, cols]
-    fresh = (line_dm[..., DM_ACT] >> 4) == st.round
-    l_act_h = jnp.where(fresh, (line_dm[..., DM_ACT] >> 2) & 3, ACT_NONE)
-    l_act_o = jnp.where(fresh, line_dm[..., DM_ACT] & 3, ACT_NONE)
-    l_req = line_dm[..., DM_REQ]
+    fan_fresh = (dm[:, DM_ACT] >> 4) == st.round
+    fan_packed = (jnp.where(fan_fresh,
+                            ((dm[:, DM_ACT] & 15) | 16) << 16, 0)
+                  | dm[:, DM_REQ])
+    line_f = fan_packed[line_e]                              # [N, C]
+    fresh = ((line_f >> 20) & 1) == 1
+    l_act_h = jnp.where(fresh, (line_f >> 18) & 3, ACT_NONE)
+    l_act_o = jnp.where(fresh, (line_f >> 16) & 3, ACT_NONE)
+    l_req = line_f & 0xFFFF
     l_home = line_e >> cfg.block_bits
     i_am_home = l_home == rows[:, None]
     a_code = jnp.where(i_am_home, l_act_h, l_act_o)
@@ -512,10 +517,33 @@ def round_step_deep(cfg: SystemConfig, st: SyncState) -> SyncState:
         invalidations=mt.invalidations + deltas[8],
         promotions=mt.promotions + deltas[9],
     )
-    return st.replace(cache_addr=ca_c, cache_val=cv_c, cache_state=cs_c,
-                      dm=dm, idx=st.idx + rp["n_ret"],
-                      horizon=jnp.clip(rp["n_ret"] + 2, 2, 1 << 20),
-                      round=st.round + 1, metrics=metrics)
+    out = st.replace(cache_addr=ca_c, cache_val=cv_c, cache_state=cs_c,
+                     dm=dm, idx=st.idx + rp["n_ret"],
+                     horizon=jnp.clip(
+                         rp["n_ret"] + cfg.deep_horizon_slack, 2,
+                         1 << 20),
+                     round=st.round + 1, metrics=metrics)
+    if return_stats:
+        s_ = lambda x: jnp.sum(x, dtype=jnp.int32)
+        stats = dict(
+            n_ret=s_(rp["n_ret"]), truncated=s_(rp["truncated"]),
+            stopped=s_(rp["stopped"]), seen_req=s_(rp["seen_req"]),
+            n_slot=s_(rp["n_slot"]), horizon_sum=s_(st.horizon),
+            att_rd=s_(kind == K_RD), att_wr=s_(kind == K_WR),
+            att_up=s_(kind == K_UP), att_evs=s_(kind == K_EVS),
+            att_evm=s_(kind == K_EVM), att_probe=s_(kind == K_PROBE),
+            lost=s_((is_req | is_ev) & ~won),
+            abort_poison=s_(req_bad & won),
+            abort_mark=s_(ev_bad & won),
+            probe_bad=s_(probe_bad),
+            committed=s_(commit), released=s_(rel))
+        return out, stats
+    if not with_events:
+        return out
+    events = {"retired": offs < rp["n_ret"][:, None],   # [N, W]
+              "op": w_oa >> 28, "addr": w_oa & 0x0FFFFFFF,
+              "value": w_val}
+    return out, events
 
 
 def dm_own_col(st: SyncState, col: int, N: int, S: int):

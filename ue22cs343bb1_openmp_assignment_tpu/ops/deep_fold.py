@@ -38,15 +38,28 @@ ACT_NONE, ACT_DOWN, ACT_KILL, ACT_PROMOTE = 0, 1, 2, 3
 
 
 def _sel(lst, idx):
-    """where-chain select: lst[idx] per node, idx a vec of list indices."""
+    """where-chain select: lst[idx] per node, idx a vec of list indices.
+
+    Bool lists use mask algebra instead of select: mosaic lowers
+    `arith.select` on i1 vectors through i8 and fails with an
+    unsupported-truncation error, so kernels must never select bools.
+    """
     out = lst[0]
     for i in range(1, len(lst)):
-        out = jnp.where(idx == i, lst[i], out)
+        m = idx == i
+        if out.dtype == jnp.bool_:
+            out = (m & lst[i]) | (~m & out)
+        else:
+            out = jnp.where(m, lst[i], out)
     return out
 
 
 def _upd(lst, idx, mask, val):
-    """lst[idx] = val where mask, per node."""
+    """lst[idx] = val where mask, per node (bools via mask algebra —
+    see _sel)."""
+    if lst[0].dtype == jnp.bool_:
+        return [((mask & (idx == i)) & val)
+                | (~(mask & (idx == i)) & r) for i, r in enumerate(lst)]
     return [jnp.where(mask & (idx == i), val, r) for i, r in enumerate(lst)]
 
 
@@ -154,7 +167,7 @@ def fold_step(cfg: SystemConfig, c, node, oa, val, live, k, horizon,
     for rh_ in rel_hit[1:]:
         rel_any_all = rel_any_all | rh_
     rel_any = rel_any_all & rem_vic
-    dup_t = dup_v = rel_hit[0] & False
+    dup_t = dup_v = jnp.zeros_like(live)
     for kk, ee in zip(c["kind"], c["ent"]):
         isrem = (kk >= K_RD) & (kk <= K_EVM)
         dup_t = dup_t | (isrem & (ee == addr))
@@ -228,7 +241,7 @@ def fold_step(cfg: SystemConfig, c, node, oa, val, live, k, horizon,
     ent = _upd(ent, o1, rem_vic_slot, jnp.clip(l_addr, 0, None))
     sval = _upd(sval, o1, rem_vic_slot, l_val)
     pos = _upd(pos, o1, rem_vic_slot, jnp.zeros_like(o1) + k)
-    comm = _upd(comm, o1, rem_vic_slot & r, jnp.bool_(True) & r)
+    comm = _upd(comm, o1, rem_vic_slot & r, r)
     fp = rem_txn_a | probe_a
     fill_kind = jnp.where(probe, K_PROBE,
                           jnp.where(rd_miss, K_RD,
@@ -238,7 +251,7 @@ def fold_step(cfg: SystemConfig, c, node, oa, val, live, k, horizon,
     ent = _upd(ent, o2, fp, jnp.clip(addr, 0, None))
     sval = _upd(sval, o2, fp, slot_v)
     pos = _upd(pos, o2, fp, jnp.zeros_like(o2) + k)
-    comm = _upd(comm, o2, (rem_txn_a & r), jnp.bool_(True) & r)
+    comm = _upd(comm, o2, (rem_txn_a & r), r)
     n_slot = c["n_slot"] + jnp.where(act, n_need, 0)
     seen_req = c["seen_req"] | rem_txn_a
 
@@ -277,14 +290,14 @@ def fold_step(cfg: SystemConfig, c, node, oa, val, live, k, horizon,
     dmo = _upd(c["dmo"], v_block, vo & promote, jnp.zeros_like(nvc) - 1)
     dmm = _upd(c["dmm"], v_block, ev_m, l_val)
     dmm_src = _upd(c["dmm_src"], v_block, ev_m, l_src)
-    touched = _upd(c["touched"], v_block, vo, jnp.bool_(True) & vo)
+    touched = _upd(c["touched"], v_block, vo, vo)
     act_acc = _upd(c["act_acc"], v_block, vo,
                    jnp.maximum(v_act, jnp.where(promote, ACT_PROMOTE,
                                                 ACT_NONE)))
     v_foreign = ev_s & (v_dmc > 1)
-    mark = _upd(c["mark"], v_block, vo & v_foreign, jnp.bool_(True))
+    mark = _upd(c["mark"], v_block, vo & v_foreign, vo & v_foreign)
     poison = _upd(c["poison"], v_block, vo & c["seen_req"],
-                  jnp.bool_(True))
+                  vo & c["seen_req"])
 
     # --- own target composition --------------------------------------------
     to = own_txn_r
@@ -306,13 +319,14 @@ def fold_step(cfg: SystemConfig, c, node, oa, val, live, k, horizon,
     dmc = _upd(dmc, block, to, ntc)
     dmo = _upd(dmo, block, to, nto)
     dmm_src = _upd(dmm_src, block, to, ntm_src)
-    touched = _upd(touched, block, to, jnp.bool_(True) & to)
+    touched = _upd(touched, block, to, to)
     act_acc = _upd(act_acc, block, to,
                    jnp.where(act_override, new_act,
                              jnp.maximum(t_act, new_act)))
     t_foreign = (t_s & (t_dmc > jnp.where(upg, 1, 0))) | t_em
-    mark = _upd(mark, block, to & t_foreign, jnp.bool_(True))
-    poison = _upd(poison, block, to & c["seen_req"], jnp.bool_(True))
+    mark = _upd(mark, block, to & t_foreign, to & t_foreign)
+    poison = _upd(poison, block, to & c["seen_req"],
+                  to & c["seen_req"])
 
     # --- fills -------------------------------------------------------------
     fstate = jnp.where(is_wr, MOD,
@@ -324,10 +338,9 @@ def fold_step(cfg: SystemConfig, c, node, oa, val, live, k, horizon,
     cv = _upd(cv, ci, fill_r, f_val)
     cv_src = _upd(cv_src, ci, fill_r, f_src)
     cs = _upd(cs, ci, fill_r, fstate)
-    rrf = [jnp.where(fill_r & (ci == i), rem_txn & rd_miss, x)
-           for i, x in enumerate(c["rrf"])]
-    wf = [jnp.where(fill_r & (ci == i), True, x)
-          for i, x in enumerate(c["wf"])]
+    rrf = [((fill_r & (ci == i)) & rem_txn & rd_miss)
+           | (~(fill_r & (ci == i)) & x) for i, x in enumerate(c["rrf"])]
+    wf = [x | (fill_r & (ci == i)) for i, x in enumerate(c["wf"])]
 
     frozen = c["frozen"] | (is_txn & ~c["stopped"] & ~stop_now)
     stopped = c["stopped"] | stop_now

@@ -412,13 +412,15 @@ def round_step(cfg: SystemConfig, st: SyncState,
     Pallas kernels on procedural workloads (ops.pallas_burst /
     ops.pallas_window), bit-identically."""
     if cfg.deep_window:
-        if with_events:
-            raise NotImplementedError(
-                "event tracing is served by the async/multi engines; "
-                "the deep-window engine is the throughput path")
+        if cfg.pallas_burst and not with_events:
+            from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_burst
+            if pallas_burst.tileable(cfg.num_nodes):
+                from ue22cs343bb1_openmp_assignment_tpu.ops.pallas_deep \
+                    import round_step_deep_pallas
+                return round_step_deep_pallas(cfg, st)
         from ue22cs343bb1_openmp_assignment_tpu.ops.deep_engine import (
             round_step_deep)
-        return round_step_deep(cfg, st)
+        return round_step_deep(cfg, st, with_events)
     if cfg.pallas_burst and cfg.procedural and not with_events:
         from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_burst
         use_pallas = pallas_burst.tileable(cfg.num_nodes)
