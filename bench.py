@@ -7,8 +7,12 @@ throughput numbers (BASELINE.md), so vs_baseline is measured against the
 north-star target.
 
 Engines (see PERF.md for the measured rationale):
-  sync   (default) — transactional engine (ops.sync_engine): atomic
-         whole-transaction rounds, no mailboxes; the throughput path.
+  deep   (default) — deep-window transactional engine
+         (ops.deep_engine + ops.pallas_deep): dense own-entry
+         transaction chains + absorbed remote events; the throughput
+         path.
+  sync   — multi-transaction window engine (ops.sync_engine): atomic
+         whole-transaction rounds, no mailboxes.
   async  — message-level engine (ops.step): reference network semantics
          cycle by cycle; the parity/race-research path.
 
@@ -24,7 +28,12 @@ import time
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--engine", choices=["sync", "async"], default="sync")
+    ap.add_argument("--engine", choices=["sync", "async", "deep"],
+                    default="deep",
+                    help="sync = multi-transaction window engine; deep = "
+                         "deep-window engine (dense own-entry chains + "
+                         "absorbed remote events, ops.deep_engine); "
+                         "async = message-level parity engine")
     ap.add_argument("--nodes", type=int, default=4096)
     ap.add_argument("--trace-len", type=int, default=4096,
                     help="instructions per node; the default is long "
@@ -50,6 +59,14 @@ def main():
                     help="sync engine: independent machines batched into "
                          "one ensemble (different workload + arbitration "
                          "seeds); throughput is aggregated")
+    ap.add_argument("--deep-slots", type=int, default=3,
+                    help="deep engine: remote-event slots per window "
+                         "(3 measured best at the headline config)")
+    ap.add_argument("--deep-g", type=int, default=2,
+                    help="deep engine: owner-value slots per window")
+    ap.add_argument("--deep-slack", type=int, default=4,
+                    help="deep engine: adaptive attempt-horizon slack "
+                         "(4 measured best; PERF.md)")
     ap.add_argument("--admission", type=int, default=None,
                     help="async engine: max concurrent outstanding "
                          "requests (None = reference drop semantics)")
@@ -94,19 +111,27 @@ def main():
     if args.smoke:
         args.nodes, args.trace_len, args.chunk = 64, 8, 8
 
-    if args.txn_width is not None and args.engine != "sync":
+    sync_like = args.engine in ("sync", "deep")
+    if args.txn_width is not None and not sync_like:
         print("error: --txn-width sizes the sync engine's multi-"
               "transaction window; use --engine sync", file=sys.stderr)
         return 2
     if args.txn_width is None:
-        args.txn_width = 3 if args.engine == "sync" else 1
+        args.txn_width = 3 if sync_like else 1
     if args.drain_depth is None:
-        args.drain_depth = 16 if args.txn_width == 1 else 4
+        args.drain_depth = (13 if args.engine == "deep"
+                            else 16 if args.txn_width == 1 else 4)
     cfg = SystemConfig.scale(num_nodes=args.nodes,
                              admission_window=args.admission,
                              drain_depth=args.drain_depth,
                              txn_width=args.txn_width)
-    if args.procedural and (args.engine != "sync"
+    if args.engine == "deep":
+        import dataclasses
+        cfg = dataclasses.replace(cfg, deep_window=True,
+                                  deep_slots=args.deep_slots,
+                                  deep_ownerval_slots=args.deep_g,
+                                  deep_horizon_slack=args.deep_slack)
+    if args.procedural and (not sync_like
                             or args.workload != "uniform"
                             or args.replicas > 1):
         print("note: --procedural needs the sync engine, the uniform "
@@ -118,6 +143,12 @@ def main():
         cfg = dataclasses.replace(
             cfg, procedural="uniform", max_instrs=1,
             proc_local_permille=int(args.local_frac * 1000))
+    # Pallas kernels: the deep engine's fold kernels serve every
+    # workload kind (the window is built in XLA, ops/pallas_deep);
+    # the multi/burst window kernels need a procedural stream and
+    # gate themselves off otherwise (sync_engine.round_step).
+    if sync_like:
+        import dataclasses
         # the kernels tile the node axis at 1024 (ops.pallas_burst._tile)
         tileable = args.nodes <= 1024 or args.nodes % 1024 == 0
         on_tpu = jax.default_backend() == "tpu"
@@ -133,9 +164,8 @@ def main():
         if args.pallas:
             cfg = dataclasses.replace(cfg, pallas_burst=True)
     elif args.pallas:
-        print("note: --pallas applies only to the sync engine's "
-              "procedural path; measuring without the Pallas kernels",
-              file=sys.stderr)
+        print("note: --pallas applies only to the sync-family engines; "
+              "measuring without the Pallas kernels", file=sys.stderr)
     gen_kw = {"local_frac": args.local_frac} if args.workload == "uniform" else {}
 
     def make_system(seed):
@@ -148,7 +178,7 @@ def main():
     # network round trip, so host-side polling would dominate the
     # measurement.
     max_cycles = 200 * args.trace_len
-    if args.engine == "sync":
+    if sync_like:
         # stay inside the claim-key round budget at very large N
         max_cycles = min(max_cycles, se.claim_max_rounds(cfg) - 1)
 
@@ -171,7 +201,7 @@ def main():
 
         def steps(st):
             return int(st.metrics.rounds[0])
-    elif args.engine == "sync" and args.procedural:
+    elif sync_like and args.procedural:
         st0 = se.procedural_state(cfg, args.trace_len, seed=0)
 
         def run():
@@ -180,7 +210,7 @@ def main():
 
         def steps(st):
             return int(st.metrics.rounds)
-    elif args.engine == "sync":
+    elif sync_like:
         st0 = se.from_sim_state(cfg, make_system(0).state, seed=0)
 
         def run():

@@ -1,0 +1,123 @@
+"""Async-engine cycle cost decomposition on the attached TPU.
+
+VERDICT r2 #10: the async (parity) engine sustains ~3.4e5 instrs/sec —
+40x below sync — and the round-1 "~50 kernels/cycle" explanation is
+obsolete under the corrected device model (kernels in a jitted scan
+are ~free; index count and sorts are the currency). This script
+isolates where an async cycle's time actually goes:
+
+  A. marginal full-cycle cost in a long scan (the real number)
+  B. deliver-only: mailbox.deliver in a scan with synthetic candidates
+  C. sort-only: the (recv, prio) two-operand sort at candidate size
+
+Timing: device_get sync, marginal over two scan lengths (PERF.md).
+"""
+
+import argparse
+import dataclasses
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem
+from ue22cs343bb1_openmp_assignment_tpu.ops import mailbox
+from ue22cs343bb1_openmp_assignment_tpu.ops.step import (_ro_outside, cycle)
+
+
+def sync(x):
+    return float(np.asarray(jax.device_get(x)).ravel()[0])
+
+
+def timeit(fn, *args, reps=3):
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sync(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def marginal(fn, r1, r2):
+    t1, t2 = timeit(fn, r1), timeit(fn, r2)
+    return (t2 - t1) / (r2 - r1) * 1e6
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def run_cycles_r(cfg, state, R):
+    carry0, ro, blanks = _ro_outside(state)
+
+    def body(s, _):
+        out = cycle(cfg, s.replace(**ro))
+        return out.replace(**blanks), None
+
+    final, _ = jax.lax.scan(body, carry0, None, length=R)
+    return final.replace(**ro).metrics.cycles
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=4096)
+    ap.add_argument("--len", type=int, default=256)
+    args = ap.parse_args()
+    N = args.nodes
+    print(f"backend={jax.default_backend()} N={N}")
+    cfg = SystemConfig.scale(num_nodes=N)
+    sys_ = CoherenceSystem.from_workload(cfg, "uniform",
+                                         trace_len=args.len, seed=0,
+                                         local_frac=0.8)
+    st = sys_.state
+
+    m = marginal(lambda R: run_cycles_r(cfg, st, R), 64, 192)
+    print(f"A. full cycle marginal: {m:.0f} us/cycle")
+
+    # B: deliver-only in a scan (synthetic candidates, ~0.5 real/node)
+    S = 3
+    rng = np.random.default_rng(0)
+    send = rng.random((N, S)) < 0.17
+    cand = mailbox.Candidates(
+        type=jnp.asarray(np.where(send, 1, 0), jnp.int32),
+        recv=jnp.asarray(rng.integers(0, N, (N, S)), jnp.int32),
+        sender=jnp.asarray(np.broadcast_to(np.arange(N)[:, None], (N, S)),
+                           jnp.int32),
+        addr=jnp.asarray(rng.integers(0, 256, (N, S)), jnp.int32),
+        value=jnp.asarray(rng.integers(0, 256, (N, S)), jnp.int32),
+        second=jnp.zeros((N, S), jnp.int32),
+        dirstate=jnp.zeros((N, S), jnp.int32),
+        bitvec=jnp.zeros((N, S, cfg.msg_bitvec_words), jnp.uint32))
+    arb = jnp.arange(N, dtype=jnp.int32)
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def deliver_scan(state, R):
+        def body(s, _):
+            upd, dropped, injected = mailbox.deliver(
+                cfg, s, cand, arb, s.mb_head, s.mb_count)
+            return s.replace(**upd), None
+        out, _ = jax.lax.scan(body, state, None, length=R)
+        return out.metrics.cycles + out.mb_count[0]
+
+    m = marginal(lambda R: deliver_scan(st, R), 64, 192)
+    print(f"B. deliver-only marginal: {m:.0f} us/cycle")
+
+    # C: the two-operand sort at candidate size
+    keys0 = jnp.asarray(rng.integers(0, 1 << 30, N * S), jnp.int32)
+    payload = jnp.asarray(rng.integers(0, 1 << 30, N * S), jnp.int32)
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def sort_scan(k0, R):
+        def body(k, _):
+            ks, vs = jax.lax.sort((k, payload), num_keys=1)
+            return ks ^ vs, None
+        out, _ = jax.lax.scan(body, k0, None, length=R)
+        return out[0]
+
+    m = marginal(lambda R: sort_scan(keys0, R), 64, 192)
+    print(f"C. sort({N * S} rows) marginal: {m:.0f} us/iter")
+
+
+if __name__ == "__main__":
+    main()

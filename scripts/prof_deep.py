@@ -38,15 +38,39 @@ def run_cfg(cfg, length, chunk=64, reps=3, max_rounds=60_000):
     return retired / el, rounds, retired, q, el
 
 
+def check_identity(N=1024, dd=13, tw=3, Q=8, G=4):
+    """Full-size XLA vs Pallas deep-round bit-identity on the TPU."""
+    import numpy as np_
+    cfg = SystemConfig.scale(N, drain_depth=dd, txn_width=tw)
+    cfg = dataclasses.replace(cfg, procedural="uniform", max_instrs=1,
+                              deep_window=True, deep_slots=Q,
+                              deep_ownerval_slots=G)
+    pcfg = dataclasses.replace(cfg, pallas_burst=True)
+    st = se.procedural_state(cfg, 256, seed=3)
+    st = se.run_rounds(cfg, st, 20)
+    a = se.run_rounds(cfg, st, 8)
+    b = se.run_rounds(pcfg, st, 8)
+    import jax as j
+    for x, y in zip(j.tree_util.tree_leaves(a), j.tree_util.tree_leaves(b)):
+        np_.testing.assert_array_equal(np_.asarray(x), np_.asarray(y))
+    print(f"identity OK: XLA == Pallas over 8 warmed rounds (N={N})")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=4096)
     ap.add_argument("--len", type=int, default=4096)
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--baseline", action="store_true")
+    ap.add_argument("--pallas", action="store_true",
+                    help="route deep rounds through ops.pallas_deep")
+    ap.add_argument("--identity", action="store_true",
+                    help="run the full-size XLA-vs-Pallas identity check")
     args = ap.parse_args()
     N, L = args.nodes, args.len
     print(f"backend={jax.default_backend()} N={N} len={L}")
+    if args.identity:
+        check_identity()
 
     if args.baseline:
         cfg = SystemConfig.scale(N, drain_depth=4, txn_width=3)
@@ -56,25 +80,30 @@ def main():
         print(f"multi K=3 pallas: {r:.3e} i/s rounds={rounds} q={q} "
               f"({ret/rounds/N:.2f}/node/round, {el*1e3/rounds:.2f} ms/round)")
 
-    for (dd, tw, Q, G) in [
-        (13, 3, 6, 3),
-        (13, 3, 8, 4),
-        (21, 3, 8, 4),
-        (29, 3, 10, 4),
-        (45, 3, 12, 4),
-        (5, 3, 6, 3),
+    for (dd, tw, Q, G, slack) in [
+        (13, 3, 6, 3, 2),
+        (13, 3, 8, 4, 2),
+        (13, 3, 8, 4, 6),
+        (21, 3, 8, 4, 8),
+        (21, 3, 10, 4, 16),
+        (29, 3, 12, 4, 16),
+        (45, 3, 12, 4, 32),
+        (5, 3, 6, 3, 2),
     ]:
         cfg = SystemConfig.scale(N, drain_depth=dd, txn_width=tw)
         cfg = dataclasses.replace(cfg, procedural="uniform", max_instrs=1,
                                   deep_window=True, deep_slots=Q,
-                                  deep_ownerval_slots=G)
+                                  deep_ownerval_slots=G,
+                                  deep_horizon_slack=slack,
+                                  pallas_burst=args.pallas)
         try:
             r, rounds, ret, q, el = run_cfg(cfg, L, reps=args.reps)
         except Exception as e:
-            print(f"deep W={dd+tw} Q={Q} G={G}: FAILED {str(e)[:100]}")
+            print(f"deep W={dd+tw} Q={Q} G={G} s={slack}: FAILED "
+                  f"{str(e)[:100]}")
             continue
-        print(f"deep W={dd+tw} Q={Q} G={G}: {r:.3e} i/s rounds={rounds} "
-              f"q={q} ({ret/rounds/N:.2f}/node/round, "
+        print(f"deep W={dd+tw} Q={Q} G={G} s={slack}: {r:.3e} i/s "
+              f"rounds={rounds} q={q} ({ret/rounds/N:.2f}/node/round, "
               f"{el*1e3/rounds:.2f} ms/round)")
 
 

@@ -1,0 +1,72 @@
+"""Deep-round anatomy on the attached TPU: what fills slots, what
+truncates windows, what caps committed depth (~4.5 at the headline
+config despite horizon slack — the round-3 question).
+
+Runs warm rounds at the given config, then collects round_step_deep's
+return_stats sums over a few rounds and prints per-node-per-round
+averages.
+"""
+
+import argparse
+import dataclasses
+
+import jax
+import numpy as np
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+from ue22cs343bb1_openmp_assignment_tpu.ops.deep_engine import (
+    round_step_deep)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=4096)
+    ap.add_argument("--len", type=int, default=2048)
+    ap.add_argument("--warm", type=int, default=40)
+    ap.add_argument("--rounds", type=int, default=8)
+    ap.add_argument("--dd", type=int, default=13)
+    ap.add_argument("--tw", type=int, default=3)
+    ap.add_argument("--slots", type=int, default=6)
+    ap.add_argument("--g", type=int, default=3)
+    ap.add_argument("--slack", type=int, default=2)
+    ap.add_argument("--local", type=int, default=800)
+    args = ap.parse_args()
+    N = args.nodes
+    cfg = SystemConfig.scale(N, drain_depth=args.dd, txn_width=args.tw)
+    cfg = dataclasses.replace(
+        cfg, procedural="uniform", max_instrs=1,
+        proc_local_permille=args.local, deep_window=True,
+        deep_slots=args.slots, deep_ownerval_slots=args.g,
+        deep_horizon_slack=args.slack)
+    print(f"backend={jax.default_backend()} N={N} W={args.dd + args.tw} "
+          f"Q={args.slots} slack={args.slack} local={args.local}")
+    st = se.procedural_state(cfg, args.len, seed=0)
+    st = se.run_rounds(cfg, st, args.warm)
+
+    step = jax.jit(lambda s: round_step_deep(cfg, s, return_stats=True))
+    acc = None
+    for _ in range(args.rounds):
+        st, stats = step(st)
+        stats = {k: int(v) for k, v in stats.items()}
+        acc = stats if acc is None else {
+            k: acc[k] + v for k, v in stats.items()}
+    R = args.rounds
+    per = {k: v / R / N for k, v in acc.items()}
+    print(f"per node per round (avg over {R} rounds):")
+    print(f"  retired {per['n_ret']:.2f}  horizon {per['horizon_sum']:.2f}"
+          f"  slots used {per['n_slot']:.2f}")
+    print(f"  attempts: rd {per['att_rd']:.2f} wr {per['att_wr']:.2f} "
+          f"up {per['att_up']:.2f} evS {per['att_evs']:.2f} "
+          f"evM {per['att_evm']:.2f} probe {per['att_probe']:.2f}")
+    print(f"  lane losses {per['lost']:.3f}  poison aborts "
+          f"{per['abort_poison']:.3f}  mark aborts {per['abort_mark']:.3f}"
+          f"  probe bad {per['probe_bad']:.3f}")
+    print(f"  committed slots {per['committed']:.2f}  released "
+          f"{per['released']:.3f}")
+    print(f"  frac nodes truncated {per['truncated']:.3f}  stopped "
+          f"{per['stopped']:.3f}  past-first-request {per['seen_req']:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -25,11 +25,12 @@ def run_bench(*args):
 
 
 @pytest.mark.parametrize("args", [
-    (),                                        # sync + procedural
+    (),                                        # deep + procedural (default)
+    ("--engine", "sync",),
     ("--engine", "async",),
-    ("--no-procedural",),
-    ("--replicas", "2", "--no-procedural"),
-    ("--txn-width", "1",),
+    ("--no-procedural",),                      # deep on stored traces
+    ("--engine", "sync", "--replicas", "2", "--no-procedural"),
+    ("--engine", "sync", "--txn-width", "1",),
 ])
 def test_single_json_line_on_stdout(args):
     out, err = run_bench(*args)

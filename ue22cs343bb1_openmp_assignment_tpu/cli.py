@@ -125,6 +125,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "procedurally in-round (O(1) trace memory; "
                         "--trace-len may be arbitrarily long); pairs "
                         "with --seed as the stream seed")
+    p.add_argument("--deep-window", action="store_true",
+                   help="sync engine: deep-window rounds (dense "
+                        "own-entry transaction chains + absorbed remote "
+                        "events, ops.deep_engine — the round-3 "
+                        "throughput path; --drain-depth sizes the "
+                        "window, default 13)")
+    p.add_argument("--deep-slots", type=int, default=8,
+                   help="deep windows: remote-event slots per window")
     p.add_argument("--sweep-seeds", type=int, metavar="K",
                    help="sync engine: run K arbitration seeds as one "
                         "vmapped ensemble and report which seeds "
@@ -197,7 +205,8 @@ def _main_sync(args) -> int:
             print("error: checkpoint was written by the async engine; "
                   "resume it without --engine sync", file=sys.stderr)
             return 2
-        if args.drain_depth is not None or args.txn_width is not None:
+        if (args.drain_depth is not None or args.txn_width is not None
+                or args.deep_window):
             # pure compute knobs (window shape; no state shapes depend
             # on them) — overridable on resume like the async path's
             # admission/drop knobs
@@ -207,6 +216,9 @@ def _main_sync(args) -> int:
                 over["drain_depth"] = args.drain_depth
             if args.txn_width is not None:
                 over["txn_width"] = args.txn_width
+            if args.deep_window:
+                over.update(deep_window=True,
+                            deep_slots=args.deep_slots)
             cfg = _dc.replace(cfg, **over)
         if args.arb_seed is not None:
             st = st.replace(seed=np.int32(args.arb_seed))
@@ -216,6 +228,10 @@ def _main_sync(args) -> int:
             dims["drain_depth"] = args.drain_depth
         if args.txn_width is not None:
             dims["txn_width"] = args.txn_width
+        if args.deep_window:
+            dims.update(deep_window=True, deep_slots=args.deep_slots,
+                        txn_width=dims.get("txn_width", 3))
+            dims.setdefault("drain_depth", 13)
         if args.procedural:
             cfg = SystemConfig.scale(
                 procedural="uniform", max_instrs=1, proc_seed=args.seed,
@@ -429,6 +445,10 @@ def main(argv=None) -> int:
         print("error: --txn-width sizes the transactional engine's "
               "multi-transaction window; add --engine sync",
               file=sys.stderr)
+        return 2
+    if args.deep_window and args.engine != "sync":
+        print("error: --deep-window is a transactional-engine round "
+              "mode; add --engine sync", file=sys.stderr)
         return 2
     if args.engine == "sync":
         return _main_sync(args)

@@ -86,6 +86,13 @@ class SystemConfig:
     deep_slots: int = 8
     # per-node per-round budget of own-entry EM-owner value resolutions
     deep_ownerval_slots: int = 4
+    # adaptive attempt-horizon slack: next round's attempt cap is
+    # last round's retirement + slack (lane politeness: attempts past
+    # the cap would claim per-entry lanes they rarely commit, starving
+    # other nodes' events). Larger slack = more speculative depth; the
+    # steady state solves n ~= c*(n + slack) for commit ratio c, so
+    # slack directly scales committed window depth (PERF.md).
+    deep_horizon_slack: int = 2
 
     # Procedural workload (sync engine): when set (e.g. "uniform"),
     # instructions are computed per (node, index) from a counter-based
@@ -128,6 +135,10 @@ class SystemConfig:
             raise ValueError(
                 "deep_window packs block indices in 16 bits; "
                 "mem_size must be <= 65536")
+        if self.deep_window and self.num_nodes > (1 << 16):
+            raise ValueError(
+                "deep_window packs requester ids in 16 bits (fan-out "
+                "column); num_nodes must be <= 65536")
         if self.txn_width < 1:
             raise ValueError("txn_width must be >= 1")
         if self.inv_mode not in ("mailbox", "scatter"):
