@@ -42,6 +42,15 @@ def instruction_phase(cfg: SystemConfig, state: SimState, may_issue):
     # schedule gate (inert at delay=0, period=1)
     since = state.cycle - state.issue_delay
     gate = (since >= 0) & (since % jnp.maximum(state.issue_period, 1) == 0)
+    if state.order_rank.shape[-1]:
+        # interleaving replay (utils.order_replay): instruction i of
+        # node n issues only when exactly order_rank[n, i] instructions
+        # have issued machine-wide — at most one fetch per cycle, so
+        # the recorded global order is reproduced exactly
+        nxt = jnp.clip(state.instr_idx + 1, 0,
+                       state.order_rank.shape[-1] - 1)
+        gate = gate & (state.order_rank[rows, nxt]
+                       == state.metrics.instrs_retired)
 
     has_more = state.instr_idx < state.instr_count - 1  # assignment.c:632
     fetch = may_issue & gate & has_more

@@ -238,7 +238,7 @@ def cycle(cfg: SystemConfig, state: SimState,
 # -- runners ---------------------------------------------------------------
 
 _RO_FIELDS = ("instr_op", "instr_addr", "instr_val", "issue_delay",
-              "issue_period", "arb_rank")
+              "issue_period", "arb_rank", "order_rank")
 
 
 def _ro_outside(state: SimState):

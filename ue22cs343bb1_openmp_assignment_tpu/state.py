@@ -113,6 +113,13 @@ class SimState(struct.PyTreeNode):
     # deterministic, seedable stand-in for the reference's OS
     # lock-acquisition order (quirk source for test_3/test_4).
     arb_rank: jnp.ndarray      # [N] i32 permutation of node ids
+    # Interleaving replay (utils.order_replay): global issue rank of each
+    # instruction, parsed from a recorded ``instruction_order.txt``
+    # (``assignment.c:649-652``). Instruction i of node n may issue only
+    # when exactly order_rank[n, i] instructions have issued machine-wide
+    # — exactly one fetch per cycle, reproducing the recorded global
+    # interleaving. Zero-width ([N, 0]) = replay disabled (the default).
+    order_rank: jnp.ndarray    # [N, T] i32 (or [N, 0] when unused)
 
     # PRNG state for fault injection (cfg.drop_prob); split each cycle
     # inside delivery so drop patterns are reproducible from the seed.
@@ -141,7 +148,8 @@ class SimState(struct.PyTreeNode):
 
 def init_state(cfg: SystemConfig, traces=None, issue_delay=None,
                issue_period=None, instr_arrays=None,
-               arb_rank=None, fault_seed: int = 0) -> SimState:
+               arb_rank=None, fault_seed: int = 0,
+               order_rank=None) -> SimState:
     """Build the initial machine state.
 
     Mirrors ``initializeProcessor`` (``assignment.c:806-851``): memory
@@ -195,6 +203,8 @@ def init_state(cfg: SystemConfig, traces=None, issue_delay=None,
         issue_delay=jnp.asarray(issue_delay, jnp.int32),
         issue_period=jnp.asarray(issue_period, jnp.int32),
         arb_rank=jnp.asarray(arb_rank, jnp.int32),
+        order_rank=(jnp.zeros((N, 0), jnp.int32) if order_rank is None
+                    else jnp.asarray(order_rank, jnp.int32)),
         fault_key=fault_key_from_seed(fault_seed),
         cycle=jnp.zeros((), jnp.int32),
         metrics=Metrics.zeros(),

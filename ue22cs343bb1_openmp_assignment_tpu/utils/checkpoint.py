@@ -124,6 +124,11 @@ def load_checkpoint(path: str) -> Tuple[SystemConfig, SimState, dict]:
         state_fields["horizon"] = np.full(
             state_fields["idx"].shape[:-1] + (n,), 1 << 20, np.int32)
         got.add("horizon")
+    if "order_rank" in expected and "order_rank" not in got:
+        # replay gating is off by default; older checkpoints resume ungated
+        state_fields["order_rank"] = np.zeros(
+            state_fields["instr_count"].shape + (0,), np.int32)
+        got.add("order_rank")
     if got != expected:
         raise ValueError(f"checkpoint fields {sorted(got)} != "
                          f"state fields {sorted(expected)}")
