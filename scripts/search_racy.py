@@ -1,0 +1,127 @@
+"""Schedule search: reach EVERY accepted racy outcome (VERDICT r2 #5).
+
+The reference's retry harness (`test3.sh:6-33`, `test4.sh:6-32`) can
+land on any of tests/test_3/run_{1,2} and tests/test_4/run_{1..4};
+this repo replaces wall-clock retry with explicit schedule knobs
+(issue delays x issue periods x arbitration rank). This script sweeps
+those knobs on the native C++ engine (host speed, deterministic) and
+prints one witness schedule per accepted run — the witnesses are
+pinned as tests in tests/test_racy_outcomes.py.
+
+Usage: python scripts/search_racy.py [--suite test_3|test_4]
+       [--max-delay 12] [--periods 1 2 3] [--arb-seeds 8]
+"""
+
+import argparse
+import itertools
+import os
+import sys
+import types
+
+import numpy as np
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.native.bindings import NativeEngine
+from ue22cs343bb1_openmp_assignment_tpu.utils.golden import (
+    format_node_dump, state_to_dumps)
+from ue22cs343bb1_openmp_assignment_tpu.utils.search import (
+    load_accepted_named)
+from ue22cs343bb1_openmp_assignment_tpu.utils.trace import load_test_dir
+
+REFERENCE_TESTS = "/root/reference/tests"
+
+
+def _arb_rank(seed: int, n: int) -> np.ndarray:
+    rng = np.random.RandomState(seed)
+    return rng.permutation(n).astype(np.int32)
+
+
+def run_schedule(cfg, traces, delays, periods, arb_seed):
+    eng = NativeEngine(cfg)
+    eng.load_traces(traces)
+    if delays is not None or periods is not None:
+        eng.set_schedule(delays, periods)
+    if arb_seed is not None:
+        eng.set_arbitration(_arb_rank(arb_seed, cfg.num_nodes))
+    eng.run(100_000)
+    assert eng.quiescent
+    ns = types.SimpleNamespace(**eng.export_state())
+    return [format_node_dump(d) for d in state_to_dumps(cfg, ns)]
+
+
+def search(suite, max_delay, periods_opts, arb_seeds, budget=200_000):
+    cfg = SystemConfig.reference()
+    traces = load_test_dir(os.path.join(REFERENCE_TESTS, suite))
+    named = load_accepted_named(os.path.join(REFERENCE_TESTS, suite))
+    accepted = {name: dumps for name, dumps in named}
+    active = [n for n, tr in enumerate(traces) if tr]
+    found = {}
+    tried = 0
+
+    def attempt(delays, periods, arb_seed):
+        nonlocal tried
+        tried += 1
+        dumps = run_schedule(cfg, traces, delays, periods, arb_seed)
+        for name, acc in accepted.items():
+            if name not in found and dumps == acc:
+                found[name] = (delays, periods, arb_seed)
+                print(f"  {suite}/{name}: delays={delays} "
+                      f"periods={periods} arb_seed={arb_seed} "
+                      f"(attempt {tried})")
+        return len(found) == len(accepted)
+
+    # pass 1: delay grid, default period/arb
+    for delays in itertools.product(range(max_delay + 1),
+                                    repeat=len(active)):
+        d = [0] * cfg.num_nodes
+        for n, dv in zip(active, delays):
+            d[n] = dv
+        if attempt(tuple(d), None, None) or tried >= budget:
+            return found, tried
+    # pass 2: add periods and arbitration ranks
+    for arb in range(arb_seeds):
+        for per in periods_opts:
+            p = tuple(per if n in active else 1
+                      for n in range(cfg.num_nodes))
+            for delays in itertools.product(range(0, max_delay + 1, 2),
+                                            repeat=len(active)):
+                d = [0] * cfg.num_nodes
+                for n, dv in zip(active, delays):
+                    d[n] = dv
+                if attempt(tuple(d), p, arb) or tried >= budget:
+                    return found, tried
+    # pass 3: random joint schedules
+    rng = np.random.RandomState(0)
+    while tried < budget:
+        d = tuple(int(rng.randint(0, max_delay + 1)) if n in active else 0
+                  for n in range(cfg.num_nodes))
+        p = tuple(int(rng.randint(1, 5)) for _ in range(cfg.num_nodes))
+        if attempt(d, p, int(rng.randint(0, 64))):
+            return found, tried
+    return found, tried
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--suite", choices=["test_3", "test_4"], default=None)
+    ap.add_argument("--max-delay", type=int, default=12)
+    ap.add_argument("--periods", type=int, nargs="*", default=[2, 3])
+    ap.add_argument("--arb-seeds", type=int, default=4)
+    ap.add_argument("--budget", type=int, default=200_000)
+    args = ap.parse_args()
+    suites = [args.suite] if args.suite else ["test_3", "test_4"]
+    ok = True
+    for suite in suites:
+        print(f"searching {suite} ...")
+        found, tried = search(suite, args.max_delay, args.periods,
+                              args.arb_seeds, args.budget)
+        missing = [n for n, _ in load_accepted_named(
+            os.path.join(REFERENCE_TESTS, suite)) if n not in found]
+        print(f"{suite}: {len(found)} outcomes witnessed "
+              f"in {tried} attempts; missing: {missing or 'none'}")
+        ok &= not missing
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
