@@ -67,6 +67,10 @@ def main():
     ap.add_argument("--deep-slack", type=int, default=4,
                     help="deep engine: adaptive attempt-horizon slack "
                          "(4 measured best; PERF.md)")
+    ap.add_argument("--queue-capacity", type=int, default=None,
+                    help="async engine: mailbox ring slots per node "
+                         "(default 64; the ring tensor is copied every "
+                         "cycle, so capacity directly prices the cycle)")
     ap.add_argument("--admission", type=int, default=None,
                     help="async engine: max concurrent outstanding "
                          "requests (None = reference drop semantics)")
@@ -121,10 +125,12 @@ def main():
     if args.drain_depth is None:
         args.drain_depth = (13 if args.engine == "deep"
                             else 16 if args.txn_width == 1 else 4)
+    qkw = ({"queue_capacity": args.queue_capacity}
+           if args.queue_capacity else {})
     cfg = SystemConfig.scale(num_nodes=args.nodes,
                              admission_window=args.admission,
                              drain_depth=args.drain_depth,
-                             txn_width=args.txn_width)
+                             txn_width=args.txn_width, **qkw)
     if args.engine == "deep":
         import dataclasses
         cfg = dataclasses.replace(cfg, deep_window=True,

@@ -32,7 +32,7 @@ def sync(x):
     return float(np.asarray(jax.device_get(x)).ravel()[0])
 
 
-def timeit(fn, *args, reps=3):
+def timeit(fn, *args, reps=5):
     ts = []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -72,7 +72,7 @@ def main():
                                          local_frac=0.8)
     st = sys_.state
 
-    m = marginal(lambda R: run_cycles_r(cfg, st, R), 64, 192)
+    m = marginal(lambda R: run_cycles_r(cfg, st, R), 64, 448)
     print(f"A. full cycle marginal: {m:.0f} us/cycle")
 
     # B: deliver-only in a scan (synthetic candidates, ~0.5 real/node)
@@ -100,7 +100,7 @@ def main():
         out, _ = jax.lax.scan(body, state, None, length=R)
         return out.metrics.cycles + out.mb_count[0]
 
-    m = marginal(lambda R: deliver_scan(st, R), 64, 192)
+    m = marginal(lambda R: deliver_scan(st, R), 64, 448)
     print(f"B. deliver-only marginal: {m:.0f} us/cycle")
 
     # C: the two-operand sort at candidate size
@@ -115,7 +115,7 @@ def main():
         out, _ = jax.lax.scan(body, k0, None, length=R)
         return out[0]
 
-    m = marginal(lambda R: sort_scan(keys0, R), 64, 192)
+    m = marginal(lambda R: sort_scan(keys0, R), 64, 448)
     print(f"C. sort({N * S} rows) marginal: {m:.0f} us/iter")
 
 
