@@ -64,6 +64,11 @@ def main():
                          "(3 measured best at the headline config)")
     ap.add_argument("--deep-g", type=int, default=2,
                     help="deep engine: owner-value slots per window")
+    ap.add_argument("--deep-waves", type=int, default=1,
+                    help="deep engine: absorption waves — up to this "
+                         "many same-class fill requests compose per "
+                         "directory entry per round (the contended-"
+                         "workload lever; 1 = classic single winner)")
     ap.add_argument("--deep-slack", type=int, default=4,
                     help="deep engine: adaptive attempt-horizon slack "
                          "(4 measured best; PERF.md)")
@@ -126,7 +131,7 @@ def main():
         args.drain_depth = (13 if args.engine == "deep"
                             else 16 if args.txn_width == 1 else 4)
     qkw = ({"queue_capacity": args.queue_capacity}
-           if args.queue_capacity else {})
+           if args.queue_capacity is not None else {})
     cfg = SystemConfig.scale(num_nodes=args.nodes,
                              admission_window=args.admission,
                              drain_depth=args.drain_depth,
@@ -136,7 +141,8 @@ def main():
         cfg = dataclasses.replace(cfg, deep_window=True,
                                   deep_slots=args.deep_slots,
                                   deep_ownerval_slots=args.deep_g,
-                                  deep_horizon_slack=args.deep_slack)
+                                  deep_horizon_slack=args.deep_slack,
+                                  deep_waves=args.deep_waves)
     if args.procedural and (not sync_like
                             or args.workload != "uniform"
                             or args.replicas > 1):

@@ -93,6 +93,13 @@ class SystemConfig:
     # steady state solves n ~= c*(n + slack) for commit ratio c, so
     # slack directly scales committed window depth (PERF.md).
     deep_horizon_slack: int = 2
+    # absorption waves: per round, up to deep_waves foreign events
+    # compose per directory entry (wave 0 = the classic one winner per
+    # entry; waves 1+ serialize additional FILL REQUESTS on flag-clean
+    # entries against the wave's composed row — the contended-workload
+    # lever, ops/deep_engine "absorption waves"). 1 = today's
+    # single-winner rounds.
+    deep_waves: int = 1
 
     # Procedural workload (sync engine): when set (e.g. "uniform"),
     # instructions are computed per (node, index) from a counter-based

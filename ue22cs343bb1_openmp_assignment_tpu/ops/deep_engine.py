@@ -279,8 +279,44 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     prio_self = prio                                          # [N]
     prio_home = _round_key(cfg, st, safe_ent >> cfg.block_bits) & pmask
     home_wins = prio_home < prio_self[:, None]               # [N, Q]
-    req_bad = is_req & (~won | (((got_flags & F_POISON) != 0)
-                                & home_wins))
+    aborting = ((is_req & ((got_flags & F_POISON) != 0) & home_wins)
+                | (is_ev & ((got_flags & F_MARK) != 0) & home_wins))
+    # ---- absorption waves (cfg.deep_waves > 1) ---------------------------
+    # extra per-entry winners: after the wave-0 lane, up to
+    # deep_waves-1 additional FILL REQUESTS commit per entry, each
+    # composing against the previous wave's row. Restricted to
+    # flag-clean entries (no chain conflict -> no order-cycle risk; a
+    # chain-touched entry with any foreign interest always carries
+    # mark/poison, so clean == chain-untouched) and to requests
+    # (notices stay single-wave: a notice composing after a same-round
+    # foreign event has no legal serialization). Lost-in-all-waves
+    # feeds the replay fold's truncation exactly like a wave-0 loss.
+    won_list = [won]
+    won_any = won
+    if cfg.deep_waves > 1:
+        # class homogeneity: all of an entry's wave commits must be the
+        # same class as its wave-0 winner — write-like chains (each
+        # write kills every earlier holder, so the single composed KILL
+        # act is exact) or read-like chains (downgrades only). A MIXED
+        # sequence (write then read) has no single-act fan-out
+        # encoding: the flushed writer must survive as SHARED while
+        # pre-write holders die. Mixed pairs keep wave-0-only behavior.
+        wlike_kind = (kind == K_WR) | (kind == K_UP)
+        wclass = jnp.zeros((E,), jnp.int32).at[
+            jnp.where(won & (is_req | is_ev), ent, E).reshape(-1)].set(
+            jnp.where(wlike_kind, 2, 1).reshape(-1), mode="drop")
+        got_class = wclass[safe_ent]
+        for _ in range(cfg.deep_waves - 1):
+            cand = (is_req & (got_flags == 0) & ~won_any
+                    & (jnp.where(wlike_kind, 2, 1) == got_class))
+            wave_idx = jnp.where(cand, ent, E).reshape(-1)
+            lane_j = jnp.full((E,), _INT_MAX, jnp.int32).at[
+                wave_idx].min(key_q.reshape(-1), mode="drop")
+            won_j = cand & (lane_j[safe_ent] == key_q)
+            won_list.append(won_j)
+            won_any = won_any | won_j
+    req_bad = is_req & (~won_any | (((got_flags & F_POISON) != 0)
+                                    & home_wins))
     ev_bad = is_ev & (~won | (((got_flags & F_MARK) != 0)
                               & home_wins))
     # probes: a fresh marker (the entry's home chain-transacted on it)
@@ -349,115 +385,161 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     dm = merged
 
     # ---- request composition (post-merge, per committed slot) ------------
-    commit = (is_req | is_ev) & won & rp["comm"]
-    g_rows = dm[safe_ent]                                    # [N, Q, cols]
-    r_state = g_rows[..., DM_STATE]
-    r_cnt = g_rows[..., DM_COUNT]
-    r_own = g_rows[..., DM_OWNER]
-    r_mem = g_rows[..., DM_MEM]
-    r_act = g_rows[..., DM_ACT]
+    # one pass per absorption wave: wave j's winners compose against
+    # the row as left by wave j-1 (re-gathered after its commit
+    # scatter). W-like winners record their written value in a dense
+    # round-value array `rv` so later-wave reads/writes on the same
+    # entry source the in-flight value (memory is NOT written by
+    # write-allocate, quirk; cv_req cannot see this round's fills).
     r_ci = codec.cache_index(cfg, safe_ent)
-    # a pending row (same-round promotion, owner == -1) serves its
-    # memory as the owner value: SHARED lines are clean, and the
-    # promoted-E line's value equals mem
-    r_pend = (r_state == D_EM) & (r_own == -1)
-    own_val = jnp.where(
-        r_pend, r_mem,
-        cv_req_m.reshape(-1)[jnp.clip(r_own, 0, N - 1) * C + r_ci])
-    r_u = r_state == D_U
-    r_s = r_state == D_S
-    r_em = r_state == D_EM
-    k_rd = commit & (kind == K_RD)
-    k_wr = commit & (kind == K_WR)
-    k_up = commit & (kind == K_UP)
-    k_evs = commit & (kind == K_EVS)
-    k_evm = commit & (kind == K_EVM)
-    wlike = k_wr | k_up
-    # release: the requester displaced its own window fill of this
-    # entry later in the window (replay-gated, so only committed
-    # displacements count); the slot commits the fill+evict NET row
-    rel = rp["rel"] & (k_rd | wlike)
-    relv, reld = rp["relv"], rp["reld"]
-    # new row from composition. An EVICT_SHARED from an E-line holder
-    # finds the row EM{evictor} (exactness) and leaves it Uncached —
-    # the reference's clear-bit -> 0 sharers path (assignment.c:560-570)
-    evs_cnt = jnp.where(r_s, r_cnt - 1, r_cnt)
-    n_state = jnp.where(wlike, D_EM,
-               jnp.where(k_rd, jnp.where(r_u, D_EM, D_S),
-                jnp.where(k_evm | (k_evs & r_em), D_U,
-                 jnp.where(k_evs & r_s,
-                           jnp.where(evs_cnt == 0, D_U,
-                                     jnp.where(evs_cnt == 1, D_EM, D_S)),
-                           r_state))))
-    n_cnt = jnp.where(wlike | (k_rd & r_u), 1,
-             jnp.where(k_rd & r_em, 2,
-              jnp.where(k_rd & r_s, r_cnt + 1,
-               jnp.where(k_evm | (k_evs & r_em), 0,
-                jnp.where(k_evs & r_s, evs_cnt, r_cnt)))))
     req_id = jnp.broadcast_to(rows[:, None], (N, Q))
-    n_own = jnp.where(wlike | (k_rd & r_u), req_id,
-             jnp.where(k_evs & r_s & (evs_cnt == 1), -1, r_own))
-    n_mem = jnp.where((k_rd | k_wr) & r_em, own_val,
-                      jnp.where(k_evm, sval, r_mem))
-    # release net-row overrides: a released read leaves the row as it
-    # was (EM keeps its owner, memory takes the owner's flushed value);
-    # a released write nets Uncached with our final written value
-    n_state = jnp.where(rel, jnp.where(wlike, D_U,
-                                       jnp.where(r_em, D_EM, r_state)),
-                        n_state)
-    n_cnt = jnp.where(rel, jnp.where(wlike, 0,
-                                     jnp.where(r_em, 1, r_cnt)), n_cnt)
-    n_own = jnp.where(rel, r_own, n_own)
-    n_mem = jnp.where(rel, jnp.where(wlike, relv,
-                                     jnp.where(r_em, own_val, r_mem)),
-                      n_mem)
-    # fan-out action composition, split by target: the home's own line
-    # takes act_h, every other tag-matching holder takes act_o.
-    # Downgrade/promote are targeted at the row's recorded owner, which
-    # may or may not be the home's line.
-    tgt_home = r_own == (safe_ent >> cfg.block_bits)
-    my_h = jnp.where(wlike, ACT_KILL,
-            jnp.where(k_rd & r_em & tgt_home,
-                      jnp.where(rel, ACT_PROMOTE, ACT_DOWN),
-             jnp.where(k_evs & r_s & (evs_cnt == 1), ACT_PROMOTE,
-                       ACT_NONE)))
-    my_o = jnp.where(wlike, ACT_KILL,
-            jnp.where(k_rd & r_em & ~tgt_home,
-                      jnp.where(rel, ACT_PROMOTE, ACT_DOWN),
-             jnp.where(k_evs & r_s & (evs_cnt == 1), ACT_PROMOTE,
-                       ACT_NONE)))
-    chain_fresh = (r_act >> 4) == st.round
-    chain_act = jnp.where(chain_fresh, r_act & 3, ACT_NONE)
-    # promote-then-X overrides: a plain read nets a DOWNGRADE (the
-    # promotee may be an old E/M owner — the one composed action must
-    # still take its line to SHARED); a released read re-promotes; a
-    # write kills; a notice means the promotee itself evicted
-    act_o = jnp.where(chain_act == ACT_PROMOTE,
-                      jnp.where(wlike, ACT_KILL,
-                                jnp.where(k_rd & rel, ACT_PROMOTE,
-                                          jnp.where(k_rd, ACT_DOWN,
-                                                    ACT_NONE))),
-                      jnp.maximum(chain_act, my_o))
-    act_h = my_h                               # effect on the home's line
-    n_act = rtag | (act_h << 2) | act_o
-    # pending flag for rows we leave EM with unknown owner
-    t_idx = jnp.where(commit, safe_ent, E).reshape(-1)
-    t_rows = jnp.stack(
-        [n_state, n_cnt, n_own, n_mem, n_act, req_id, key_q],
-        axis=-1).reshape(-1, DM_COLS)
-    dm = dm.at[t_idx].set(t_rows, mode="drop")
-
-    # ---- reply patches on the requester's cache --------------------------
-    # committed remote rd fills resolve E vs S and the fill value here
-    fill_e = k_rd & r_u
-    fill_val = jnp.where(r_em, own_val, r_mem)
-    patch = k_rd & ~rel          # a released fill's line was displaced
-    ca_c, cv_c, cs_c = rp["ca"], cv_m, rp["cs"]
     c_iota = jnp.arange(C, dtype=jnp.int32)[None, :]
+    ca_c, cv_c, cs_c = rp["ca"], cv_m, rp["cs"]
+    # round-value array: bit 8 = owner wrote this round (bits 0-7 the
+    # value); bit 9 = owner acquired CLEAN this round (read fill — its
+    # value IS the row's memory). Later waves source owner values from
+    # here; cv_req cannot see this round's fills.
+    rv = jnp.zeros((E,), jnp.int32)
+    commit_acc = jnp.zeros((N, Q), bool)
+    rel_acc = jnp.zeros((N, Q), bool)
+    patch_acc = jnp.zeros((N, Q), bool)
+    fille_acc = jnp.zeros((N, Q), bool)
+    fillv_acc = jnp.zeros((N, Q), jnp.int32)
+    for j, won_j in enumerate(won_list):
+        commit = (is_req | is_ev) & won_j & rp["comm"]
+        commit_acc = commit_acc | commit
+        g_rows = dm[safe_ent]                                # [N, Q, cols]
+        r_state = g_rows[..., DM_STATE]
+        r_cnt = g_rows[..., DM_COUNT]
+        r_own = g_rows[..., DM_OWNER]
+        r_mem = g_rows[..., DM_MEM]
+        r_act = g_rows[..., DM_ACT]
+        # a pending row (same-round promotion, owner == -1) serves its
+        # memory as the owner value: SHARED lines are clean, and the
+        # promoted-E line's value equals mem
+        r_pend = (r_state == D_EM) & (r_own == -1)
+        own_val = jnp.where(
+            r_pend, r_mem,
+            cv_req_m.reshape(-1)[jnp.clip(r_own, 0, N - 1) * C + r_ci])
+        if j > 0:
+            rv_got = rv[safe_ent]
+            own_val = jnp.where((rv_got & 0x200) != 0, r_mem, own_val)
+            own_val = jnp.where((rv_got & 0x100) != 0, rv_got & 0xFF,
+                                own_val)
+        r_u = r_state == D_U
+        r_s = r_state == D_S
+        r_em = r_state == D_EM
+        k_rd = commit & (kind == K_RD)
+        k_wr = commit & (kind == K_WR)
+        k_up = commit & (kind == K_UP)
+        k_evs = commit & (kind == K_EVS)
+        k_evm = commit & (kind == K_EVM)
+        wlike = k_wr | k_up
+        # release: the requester displaced its own window fill of this
+        # entry later in the window (replay-gated, so only committed
+        # displacements count); the slot commits the fill+evict NET row
+        rel = rp["rel"] & (k_rd | wlike)
+        rel_acc = rel_acc | rel
+        relv = rp["relv"]
+        # new row from composition. An EVICT_SHARED from an E-line
+        # holder finds the row EM{evictor} (exactness) and leaves it
+        # Uncached — the reference's clear-bit -> 0 sharers path
+        # (assignment.c:560-570)
+        evs_cnt = jnp.where(r_s, r_cnt - 1, r_cnt)
+        n_state = jnp.where(wlike, D_EM,
+                   jnp.where(k_rd, jnp.where(r_u, D_EM, D_S),
+                    jnp.where(k_evm | (k_evs & r_em), D_U,
+                     jnp.where(k_evs & r_s,
+                               jnp.where(evs_cnt == 0, D_U,
+                                         jnp.where(evs_cnt == 1, D_EM,
+                                                   D_S)),
+                               r_state))))
+        n_cnt = jnp.where(wlike | (k_rd & r_u), 1,
+                 jnp.where(k_rd & r_em, 2,
+                  jnp.where(k_rd & r_s, r_cnt + 1,
+                   jnp.where(k_evm | (k_evs & r_em), 0,
+                    jnp.where(k_evs & r_s, evs_cnt, r_cnt)))))
+        n_own = jnp.where(wlike | (k_rd & r_u), req_id,
+                 jnp.where(k_evs & r_s & (evs_cnt == 1), -1, r_own))
+        n_mem = jnp.where((k_rd | k_wr) & r_em, own_val,
+                          jnp.where(k_evm, sval, r_mem))
+        # release net-row overrides: a released read leaves the row as
+        # it was (EM keeps its owner, memory takes the owner's flushed
+        # value); a released write nets Uncached with our final value
+        n_state = jnp.where(rel, jnp.where(wlike, D_U,
+                                           jnp.where(r_em, D_EM,
+                                                     r_state)),
+                            n_state)
+        n_cnt = jnp.where(rel, jnp.where(wlike, 0,
+                                         jnp.where(r_em, 1, r_cnt)),
+                          n_cnt)
+        n_own = jnp.where(rel, r_own, n_own)
+        n_mem = jnp.where(rel, jnp.where(wlike, relv,
+                                         jnp.where(r_em, own_val,
+                                                   r_mem)),
+                          n_mem)
+        # fan-out action composition, split by target: the home's own
+        # line takes act_h, every other tag-matching holder act_o.
+        # Downgrade/promote target the row's recorded owner, which may
+        # or may not be the home's line.
+        tgt_home = r_own == (safe_ent >> cfg.block_bits)
+        my_h = jnp.where(wlike, ACT_KILL,
+                jnp.where(k_rd & r_em & tgt_home,
+                          jnp.where(rel, ACT_PROMOTE, ACT_DOWN),
+                 jnp.where(k_evs & r_s & (evs_cnt == 1), ACT_PROMOTE,
+                           ACT_NONE)))
+        my_o = jnp.where(wlike, ACT_KILL,
+                jnp.where(k_rd & r_em & ~tgt_home,
+                          jnp.where(rel, ACT_PROMOTE, ACT_DOWN),
+                 jnp.where(k_evs & r_s & (evs_cnt == 1), ACT_PROMOTE,
+                           ACT_NONE)))
+        chain_fresh = (r_act >> 4) == st.round
+        chain_act = jnp.where(chain_fresh, r_act & 3, ACT_NONE)
+        prev_ah = jnp.where(chain_fresh, (r_act >> 2) & 3, ACT_NONE)
+        # promote-then-X overrides: a plain read nets a DOWNGRADE (the
+        # promotee may be an old E/M owner — the one composed action
+        # must still take its line to SHARED); a released read
+        # re-promotes; a write kills; a notice means the promotee
+        # itself evicted. The same composition applies to the home's
+        # own action across waves (prev_ah is 0 for chain rows, so
+        # wave 0 reduces to act_h = my_h).
+        def _compose(prev, mine):
+            return jnp.where(prev == ACT_PROMOTE,
+                             jnp.where(wlike, ACT_KILL,
+                                       jnp.where(k_rd & rel, ACT_PROMOTE,
+                                                 jnp.where(k_rd, ACT_DOWN,
+                                                           ACT_NONE))),
+                             jnp.maximum(prev, mine))
+        act_o = _compose(chain_act, my_o)
+        act_h = _compose(prev_ah, my_h)
+        n_act = rtag | (act_h << 2) | act_o
+        t_idx = jnp.where(commit, safe_ent, E).reshape(-1)
+        t_rows = jnp.stack(
+            [n_state, n_cnt, n_own, n_mem, n_act, req_id, key_q],
+            axis=-1).reshape(-1, DM_COLS)
+        dm = dm.at[t_idx].set(t_rows, mode="drop")
+        if j + 1 < len(won_list):
+            rv = rv.at[jnp.where(wlike, safe_ent, E).reshape(-1)].set(
+                (0x100 | (sval & 0xFF)).reshape(-1), mode="drop")
+            rv = rv.at[jnp.where(k_rd & r_u & ~rel, safe_ent,
+                                 E).reshape(-1)].set(0x200, mode="drop")
+
+        # reply patches on the requester's cache: committed remote rd
+        # fills resolve E vs S and the fill value here. Accumulated
+        # across waves (commits are slot-disjoint) and applied after
+        # the loop in WINDOW-SLOT order — a node may commit fills on
+        # the same cache index in different waves, and the later
+        # window slot must land last.
+        fill_e = k_rd & r_u
+        fill_val = jnp.where(r_em, own_val, r_mem)
+        patch = k_rd & ~rel      # a released fill's line was displaced
+        patch_acc = patch_acc | patch
+        fille_acc = fille_acc | fill_e
+        fillv_acc = jnp.where(patch, fill_val, fillv_acc)
     for q in range(Q):
-        oh = (r_ci[:, q][:, None] == c_iota) & patch[:, q][:, None]
-        cs_c = jnp.where(oh & fill_e[:, q][:, None], EXC, cs_c)
-        cv_c = jnp.where(oh, fill_val[:, q][:, None], cv_c)
+        oh = (r_ci[:, q][:, None] == c_iota) & patch_acc[:, q][:, None]
+        cs_c = jnp.where(oh & fille_acc[:, q][:, None], EXC, cs_c)
+        cv_c = jnp.where(oh, fillv_acc[:, q][:, None], cv_c)
 
     # ---- fan-out ---------------------------------------------------------
     # act + req pack into ONE dense [E] column (bit 20 = fresh, bits
@@ -498,7 +580,7 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
         cntr["rd_miss"],
         cntr["wr_miss"],
         cntr["upg"],
-        jnp.sum((is_req | is_ev) & ~won, axis=1, dtype=jnp.int32),
+        jnp.sum((is_req | is_ev) & ~won_any, axis=1, dtype=jnp.int32),
         cntr["ev"],
         jnp.sum(kill, axis=1, dtype=jnp.int32),
         jnp.sum(promo, axis=1, dtype=jnp.int32),
@@ -532,11 +614,11 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
             att_rd=s_(kind == K_RD), att_wr=s_(kind == K_WR),
             att_up=s_(kind == K_UP), att_evs=s_(kind == K_EVS),
             att_evm=s_(kind == K_EVM), att_probe=s_(kind == K_PROBE),
-            lost=s_((is_req | is_ev) & ~won),
-            abort_poison=s_(req_bad & won),
-            abort_mark=s_(ev_bad & won),
+            lost=s_((is_req | is_ev) & ~won_any & ~aborting),
+            abort_poison=s_(aborting & is_req),
+            abort_mark=s_(aborting & is_ev),
             probe_bad=s_(probe_bad),
-            committed=s_(commit), released=s_(rel))
+            committed=s_(commit_acc), released=s_(rel_acc))
         return out, stats
     if not with_events:
         return out
