@@ -1,0 +1,70 @@
+"""L. Does scatter/gather cost scale with ROW WIDTH at fixed index count?
+
+The deep round's composition pays a [E, 7] row gather + [E, 7] row
+scatter per wave (ops/deep_engine request composition). If cost scales
+with gathered/scattered ELEMENTS (indices x width) rather than indices
+alone, packing the 7 int32 columns into fewer words is a direct win;
+if cost is per-index only, packing buys nothing. Measures the marginal
+cost of a gather+scatter pair over widths 1/2/4/7 at the headline
+round's index count (N*Q = 12288 on E = 16384 rows), plus the 65536-row
+variant for the ladder.
+"""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sync(x):
+    return float(np.asarray(jax.device_get(x)).ravel()[0])
+
+
+def timeit(fn, *args, reps=5):
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sync(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def marg(f, Rs=(64, 256)):
+    t1 = timeit(f, Rs[0])
+    t2 = timeit(f, Rs[1])
+    return (t2 - t1) / (Rs[1] - Rs[0]) * 1e6
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def pair(dm, idx, R):
+    E = dm.shape[0]
+    W = dm.shape[1]
+
+    def body(c, _):
+        carry_idx, d = c
+        rows = d[jnp.clip(carry_idx, 0, E - 1)]          # [n, W] gather
+        d2 = d.at[carry_idx].set(rows + 1, mode="drop")  # [n, W] scatter
+        nxt = (carry_idx + rows[:, 0]) % jnp.int32(E + E // 4)
+        return (nxt, d2), None
+    (out, d), _ = jax.lax.scan(body, (idx, dm), None, length=R)
+    return out
+
+
+def main():
+    print("backend:", jax.default_backend())
+    n = 12288                       # 3 slots x 4096 nodes
+    for E in (16384, 65536 * 16):
+        base = ((jnp.arange(n, dtype=jnp.int32)
+                 * jnp.int32(-1640531527)) % E)
+        print(f"L. gather+scatter pair, {n} idx, E={E}")
+        for W in (1, 2, 4, 7):
+            dm = jnp.zeros((E, W), jnp.int32)
+            m = marg(functools.partial(pair, dm, base))
+            print(f"  width {W}: marginal {m:.1f} us/iter")
+
+
+if __name__ == "__main__":
+    main()

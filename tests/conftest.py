@@ -18,6 +18,15 @@ import jax  # noqa: E402
 # suite is hermetic and the 8-device virtual mesh is available.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the deep-round XLA graphs take ~1-2 min
+# EACH to compile on this single-core host, and the suite compiles
+# dozens of jit variants — without a cache every pytest invocation pays
+# the full compile bill again (~an hour). Cached entries key on the
+# exact HLO, so code changes recompile exactly what they touched.
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.expanduser("~/.cache/jax_pytest_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 import pytest  # noqa: E402
 
 REFERENCE_TESTS = "/root/reference/tests"

@@ -1,0 +1,52 @@
+"""`--engine omp`: the reference OpenMP binary as a CLI backend.
+
+BASELINE's north star names a "--backend={omp,jax}" switch at the
+cache_simulator entry point; `cli --engine omp` closes it by building
+the reference source live (as tests/test_reference_binary_oracle.py
+already does for the oracle role) and running it through the same CLI
+surface. The test diffs the omp backend's dumps byte-for-byte against
+our async JAX engine on a deterministic suite — the two backends must
+agree exactly where the reference is deterministic.
+"""
+
+import os
+import shutil
+
+import pytest
+
+from tests.conftest import REFERENCE_TESTS, requires_reference
+
+REFERENCE_SRC = "/root/reference/assignment.c"
+
+pytestmark = [
+    requires_reference,
+    pytest.mark.skipif(shutil.which("gcc") is None, reason="needs gcc"),
+    pytest.mark.skipif(not os.path.isfile(REFERENCE_SRC),
+                       reason="reference source not present"),
+]
+
+
+def test_omp_backend_matches_jax_engine(tmp_path):
+    from ue22cs343bb1_openmp_assignment_tpu import cli
+
+    omp_dir = tmp_path / "omp"
+    jax_dir = tmp_path / "jax"
+    rc = cli.main(["sample", "--tests-root", REFERENCE_TESTS,
+                   "--engine", "omp", "--out-dir", str(omp_dir)])
+    assert rc == 0
+    rc = cli.main(["sample", "--tests-root", REFERENCE_TESTS,
+                   "--cpu", "--out-dir", str(jax_dir)])
+    assert rc == 0
+    for n in range(4):
+        theirs = (omp_dir / f"core_{n}_output.txt").read_text()
+        ours = (jax_dir / f"core_{n}_output.txt").read_text()
+        assert ours == theirs, f"core_{n}: omp backend diverges"
+
+
+def test_omp_backend_rejects_jax_only_flags(tmp_path):
+    from ue22cs343bb1_openmp_assignment_tpu import cli
+
+    rc = cli.main(["sample", "--tests-root", REFERENCE_TESTS,
+                   "--engine", "omp", "--out-dir", str(tmp_path),
+                   "--arb-seed", "3"])
+    assert rc == 2

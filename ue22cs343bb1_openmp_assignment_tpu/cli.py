@@ -105,14 +105,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "assignment.c:179-182)")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend (default: first device)")
-    p.add_argument("--engine", choices=["async", "sync", "native"],
+    p.add_argument("--engine", choices=["async", "sync", "native", "omp"],
                    default="async",
                    help="async = message-level JAX engine (reference "
                         "network semantics, schedule knobs, fault "
                         "injection); sync = transactional JAX engine "
                         "(atomic coherence rounds, the throughput path — "
                         "see PERF.md); native = host-side C++ engine with "
-                        "async semantics (the differential oracle)")
+                        "async semantics (the differential oracle); "
+                        "omp = build and run the reference OpenMP binary "
+                        "itself as a backend (BASELINE's "
+                        "--backend={omp,jax}; needs --reference-src and "
+                        "gcc)")
+    p.add_argument("--reference-src",
+                   default="/root/reference/assignment.c",
+                   help="--engine omp: path to the reference "
+                        "assignment.c to build (gcc -fopenmp)")
     p.add_argument("--drain-depth", type=int, default=None,
                    help="sync engine: hit-burst length per round")
     p.add_argument("--txn-width", type=int, default=None,
@@ -131,8 +139,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "events, ops.deep_engine — the round-3 "
                         "throughput path; --drain-depth sizes the "
                         "window, default 13)")
-    p.add_argument("--deep-slots", type=int, default=8,
-                   help="deep windows: remote-event slots per window")
+    p.add_argument("--deep-slots", type=int, default=None,
+                   help="deep windows: remote-event slots per window "
+                        "(default 8; on --resume an omitted flag keeps "
+                        "the checkpoint's value)")
     p.add_argument("--sweep-seeds", type=int, metavar="K",
                    help="sync engine: run K arbitration seeds as one "
                         "vmapped ensemble and report which seeds "
@@ -217,8 +227,13 @@ def _main_sync(args) -> int:
             if args.txn_width is not None:
                 over["txn_width"] = args.txn_width
             if args.deep_window:
-                over.update(deep_window=True,
-                            deep_slots=args.deep_slots)
+                over["deep_window"] = True
+            if args.deep_slots is not None:
+                # an omitted --deep-slots keeps the checkpoint's slot
+                # count: the flag default is indistinguishable from an
+                # explicit value, and silently reshaping the round on
+                # resume was an advisor finding (round 3)
+                over["deep_slots"] = args.deep_slots
             cfg = _dc.replace(cfg, **over)
         if args.arb_seed is not None:
             st = st.replace(seed=np.int32(args.arb_seed))
@@ -229,7 +244,9 @@ def _main_sync(args) -> int:
         if args.txn_width is not None:
             dims["txn_width"] = args.txn_width
         if args.deep_window:
-            dims.update(deep_window=True, deep_slots=args.deep_slots,
+            dims.update(deep_window=True,
+                        deep_slots=(8 if args.deep_slots is None
+                                    else args.deep_slots),
                         txn_width=dims.get("txn_width", 3))
             dims.setdefault("drain_depth", 13)
         if args.procedural:
@@ -424,6 +441,102 @@ def _main_native(args) -> int:
     return 0
 
 
+def _main_omp(args) -> int:
+    """--engine omp: the reference OpenMP binary as a backend.
+
+    Closes the last literal gap to BASELINE's "--backend={omp,jax}"
+    north-star flag: builds the reference source (``gcc -fopenmp``,
+    its documented build line) and runs it on the test directory
+    exactly as its harness does (``test3.sh``: background run, wait,
+    SIGKILL — the program never exits on its own,
+    ``assignment.c:126-135``), leaving core_<n>_output.txt in
+    --out-dir. The binary is the reference, so only the reference's
+    surface is available: a <test_directory> of 4 cores, no knobs."""
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import time
+
+    if not args.test_dir:
+        print("error: --engine omp runs the reference binary, which "
+              "reads a <test_directory>", file=sys.stderr)
+        return 2
+    for flag in ("workload", "delays", "periods", "arb_seed", "admission",
+                 "drop_prob", "trace_log", "save_checkpoint", "resume",
+                 "check", "check_strict", "metrics", "dump", "run_cycles",
+                 "deep_window", "sweep_seeds"):
+        if getattr(args, flag) not in (None, False, []):
+            print(f"error: --{flag.replace('_', '-')} is a JAX/native-"
+                  "engine feature; the omp backend is the reference "
+                  "binary itself", file=sys.stderr)
+            return 2
+    if args.nodes != 4:
+        print("error: the reference binary is fixed at 4 cores "
+              "(assignment.c NUM_CORES)", file=sys.stderr)
+        return 2
+    if not os.path.isfile(args.reference_src):
+        print(f"error: reference source not found at "
+              f"{args.reference_src} (set --reference-src)",
+              file=sys.stderr)
+        return 1
+    if shutil.which("gcc") is None:
+        print("error: --engine omp needs gcc", file=sys.stderr)
+        return 1
+
+    tests_root = os.path.abspath(args.tests_root)
+    suite_dir = os.path.join(tests_root, args.test_dir)
+    if not os.path.isdir(suite_dir):
+        print(f"error: no such test directory: {suite_dir}",
+              file=sys.stderr)
+        return 1
+    with tempfile.TemporaryDirectory(prefix="omp-backend-") as build:
+        exe = os.path.join(build, "cache_simulator")
+        try:
+            subprocess.run(
+                ["gcc", "-fopenmp", "-std=c2x", args.reference_src,
+                 "-o", exe],
+                check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            print(f"error: reference build failed:\n{e.stderr}",
+                  file=sys.stderr)
+            return 1
+        # the loader hardcodes a tests/ prefix relative to CWD
+        # (assignment.c:824)
+        os.symlink(tests_root, os.path.join(build, "tests"))
+        outs = [os.path.join(build, f"core_{n}_output.txt")
+                for n in range(4)]
+        proc = subprocess.Popen([exe, args.test_dir], cwd=build,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        # run-until-stable-then-kill (the reference never exits): poll
+        # for all four dumps holding stable sizes, then SIGKILL
+        deadline = max(10.0, args.max_cycles / 10_000)
+        time.sleep(1.0)
+        t0, last, stable = time.monotonic(), None, 0
+        while time.monotonic() - t0 < deadline:
+            sizes = [os.path.getsize(o) if os.path.exists(o) else -1
+                     for o in outs]
+            stable = stable + 1 if (min(sizes) >= 0
+                                    and sizes == last) else 0
+            if stable >= 3:
+                break
+            last = sizes
+            time.sleep(0.25)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+        missing = [o for o in outs if not os.path.exists(o)]
+        if missing:
+            print("error: reference binary produced no output within "
+                  f"{deadline:.0f}s", file=sys.stderr)
+            return 1
+        os.makedirs(args.out_dir, exist_ok=True)
+        for o in outs:
+            shutil.copy(o, os.path.join(args.out_dir,
+                                        os.path.basename(o)))
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.cpu:
@@ -454,6 +567,8 @@ def main(argv=None) -> int:
         return _main_sync(args)
     if args.engine == "native":
         return _main_native(args)
+    if args.engine == "omp":
+        return _main_omp(args)
 
     from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
     from ue22cs343bb1_openmp_assignment_tpu.models.system import CoherenceSystem

@@ -44,9 +44,13 @@ def instruction_phase(cfg: SystemConfig, state: SimState, may_issue):
     gate = (since >= 0) & (since % jnp.maximum(state.issue_period, 1) == 0)
     if state.order_rank.shape[-1]:
         # interleaving replay (utils.order_replay): instruction i of
-        # node n issues only when exactly order_rank[n, i] instructions
-        # have issued machine-wide — at most one fetch per cycle, so
-        # the recorded global order is reproduced exactly
+        # node n issues only when order_rank[n, i] instructions have
+        # RETIRED machine-wide (metrics.instrs_retired counts
+        # completions, not issues). Gating on the retired count means
+        # at most one instruction is in flight machine-wide, which
+        # serializes execution: the recorded global order is
+        # reproduced exactly, but the replayed run's concurrency and
+        # cycle counts are NOT faithful to the recorded run's timing
         nxt = jnp.clip(state.instr_idx + 1, 0,
                        state.order_rank.shape[-1] - 1)
         gate = gate & (state.order_rank[rows, nxt]
