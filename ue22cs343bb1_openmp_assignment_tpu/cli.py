@@ -216,7 +216,7 @@ def _main_sync(args) -> int:
                   "resume it without --engine sync", file=sys.stderr)
             return 2
         if (args.drain_depth is not None or args.txn_width is not None
-                or args.deep_window):
+                or args.deep_window or args.deep_slots is not None):
             # pure compute knobs (window shape; no state shapes depend
             # on them) — overridable on resume like the async path's
             # admission/drop knobs
@@ -463,14 +463,20 @@ def _main_omp(args) -> int:
               "reads a <test_directory>", file=sys.stderr)
         return 2
     for flag in ("workload", "delays", "periods", "arb_seed", "admission",
-                 "drop_prob", "trace_log", "save_checkpoint", "resume",
-                 "check", "check_strict", "metrics", "dump", "run_cycles",
-                 "deep_window", "sweep_seeds"):
-        if getattr(args, flag) not in (None, False, []):
-            print(f"error: --{flag.replace('_', '-')} is a JAX/native-"
-                  "engine feature; the omp backend is the reference "
-                  "binary itself", file=sys.stderr)
-            return 2
+                 "drop_prob", "fault_seed", "trace_log", "trace_msgs",
+                 "save_checkpoint", "resume", "check", "check_strict",
+                 "metrics", "dump", "run_cycles", "procedural",
+                 "drain_depth", "txn_width", "deep_window", "deep_slots",
+                 "queue_capacity", "sweep_seeds"):
+        v = getattr(args, flag)
+        # identity checks: 0 and 0.0 compare equal to False but are
+        # explicit user values and must be rejected, not dropped
+        if v is None or v is False or (isinstance(v, list) and not v):
+            continue
+        print(f"error: --{flag.replace('_', '-')} is a JAX/native-"
+              "engine feature; the omp backend is the reference "
+              "binary itself", file=sys.stderr)
+        return 2
     if args.nodes != 4:
         print("error: the reference binary is fixed at 4 cores "
               "(assignment.c NUM_CORES)", file=sys.stderr)
@@ -529,6 +535,14 @@ def _main_omp(args) -> int:
         if missing:
             print("error: reference binary produced no output within "
                   f"{deadline:.0f}s", file=sys.stderr)
+            return 1
+        if stable < 3:
+            # files exist but their sizes never held stable: the binary
+            # was likely killed mid-write; truncated dumps must not be
+            # handed out as results
+            print("error: reference outputs never stabilized within "
+                  f"{deadline:.0f}s (possibly mid-write at kill time); "
+                  "rerun on a less loaded host", file=sys.stderr)
             return 1
         os.makedirs(args.out_dir, exist_ok=True)
         for o in outs:

@@ -204,6 +204,9 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     round's anatomy as scalar sums (attempted/committed slots by kind,
     lane losses, priority aborts, truncated/stopped node counts) — the
     measurement surface behind scripts/prof_deepstats.py."""
+    if with_events and return_stats:
+        raise ValueError("with_events and return_stats are mutually "
+                         "exclusive (one round returns one extra value)")
     N, C, S = cfg.num_nodes, cfg.cache_size, 1 << cfg.block_bits
     E = N * S
     W = cfg.drain_depth + cfg.txn_width

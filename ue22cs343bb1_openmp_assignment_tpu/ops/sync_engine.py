@@ -412,7 +412,10 @@ def round_step(cfg: SystemConfig, st: SyncState,
     Pallas kernels on procedural workloads (ops.pallas_burst /
     ops.pallas_window), bit-identically."""
     if cfg.deep_window:
-        if cfg.pallas_burst and not with_events:
+        # the Pallas deep round implements single-wave semantics only;
+        # deep_waves > 1 must take the XLA round or the configured wave
+        # count would silently not run (advisor finding, round 3)
+        if cfg.pallas_burst and not with_events and cfg.deep_waves == 1:
             from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_burst
             if pallas_burst.tileable(cfg.num_nodes):
                 from ue22cs343bb1_openmp_assignment_tpu.ops.pallas_deep \
