@@ -96,6 +96,14 @@ def main():
                          "Default: on when a TPU backend is attached "
                          "(+19%% measured); off elsewhere (the CPU "
                          "interpreter is impractically slow)")
+    ap.add_argument("--sharded", action="store_true",
+                    help="shard the simulated-node axis over ALL "
+                         "attached devices (jax.sharding.Mesh + "
+                         "NamedSharding; GSPMD partitions the "
+                         "delivery/claim scatters into collectives) "
+                         "and measure the sharded run — the multi-chip "
+                         "bench mode. With one device this is the "
+                         "same computation through the sharded path.")
     ap.add_argument("--profile", metavar="DIR",
                     help="capture a jax.profiler trace of one timed run "
                          "into DIR (viewable with TensorBoard/Perfetto; "
@@ -203,12 +211,16 @@ def main():
         print("error: --replicas needs --engine sync", file=sys.stderr)
         return 2
     if args.engine == "sync" and args.replicas > 1:
+        if args.sharded:
+            print("error: --sharded and --replicas are exclusive",
+                  file=sys.stderr)
+            return 2
         reps = [se.from_sim_state(cfg, make_system(r).state, seed=r)
                 for r in range(args.replicas)]
         st0 = se.make_ensemble(reps)
 
-        def run():
-            return se.run_ensemble_to_quiescence(cfg, st0, args.chunk,
+        def runner(s):
+            return se.run_ensemble_to_quiescence(cfg, s, args.chunk,
                                                  max_cycles)
 
         def steps(st):
@@ -216,8 +228,8 @@ def main():
     elif sync_like and args.procedural:
         st0 = se.procedural_state(cfg, args.trace_len, seed=0)
 
-        def run():
-            return se.run_sync_to_quiescence(cfg, st0, args.chunk,
+        def runner(s):
+            return se.run_sync_to_quiescence(cfg, s, args.chunk,
                                              max_cycles)
 
         def steps(st):
@@ -225,21 +237,43 @@ def main():
     elif sync_like:
         st0 = se.from_sim_state(cfg, make_system(0).state, seed=0)
 
-        def run():
-            return se.run_sync_to_quiescence(cfg, st0, args.chunk,
+        def runner(s):
+            return se.run_sync_to_quiescence(cfg, s, args.chunk,
                                              max_cycles)
 
         def steps(st):
             return int(st.metrics.rounds)
     else:
-        sys_ = make_system(0)
+        st0 = make_system(0).state
 
-        def run():
-            return run_chunked_to_quiescence(cfg, sys_.state, args.chunk,
+        def runner(s):
+            return run_chunked_to_quiescence(cfg, s, args.chunk,
                                              max_cycles)
 
         def steps(st):
             return int(st.metrics.cycles)
+
+    n_dev = 1
+    if args.sharded:
+        # multi-chip mode: the node axis shards over every attached
+        # device (jax.sharding.Mesh); the jitted quiescence runners
+        # respect the input shardings, so GSPMD partitions the
+        # delivery/claim scatters into cross-device collectives
+        from ue22cs343bb1_openmp_assignment_tpu.parallel import (
+            make_mesh, shard_state)
+        devs = jax.devices()
+        n_dev = len(devs)
+        if args.nodes % n_dev:
+            print(f"error: --sharded needs --nodes divisible by the "
+                  f"{n_dev} attached devices", file=sys.stderr)
+            return 2
+        mesh = make_mesh(devs)
+        st0 = shard_state(cfg, mesh, st0)
+        print(f"sharded: node axis over {n_dev} device(s)",
+              file=sys.stderr)
+
+    def run():
+        return runner(st0)
 
     import numpy as np
 

@@ -66,6 +66,10 @@ def main():
           f"{per['released']:.3f}")
     print(f"  frac nodes truncated {per['truncated']:.3f}  stopped "
           f"{per['stopped']:.3f}  past-first-request {per['seen_req']:.3f}")
+    print(f"  clean (no post-request own touches) {per['clean']:.3f}")
+    print(f"  stop reasons: over_q {per['stop_overq']:.3f}  over_g "
+          f"{per['stop_overg']:.3f}  dup {per['stop_dup']:.3f}  dep "
+          f"{per['stop_dep']:.3f}  trace-end {per['stop_live']:.3f}")
 
 
 if __name__ == "__main__":

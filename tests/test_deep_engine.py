@@ -161,9 +161,11 @@ def test_event_tracing_matches_program_order():
 @pytest.mark.parametrize("waves,lf,seed", [
     (2, 0, 5), (3, 200, 2), (4, 500, 7), (3, 0, 11)])
 def test_absorption_waves_invariants_and_progress(waves, lf, seed):
-    """cfg.deep_waves > 1: extra same-class fill requests compose per
-    entry per round (the contended-workload lever). The exact-directory
-    invariant must hold after EVERY round and every trace must drain."""
+    """cfg.deep_waves > 1: extra fill requests — mixed read/write
+    sequences included (wave-stamp fan-out) — compose per entry per
+    round (the contended-workload lever). The exact-directory
+    invariant must hold after EVERY round and every trace must
+    drain."""
     cfg = dataclasses.replace(deep_cfg(8, lf, seed=seed, dd=3, tw=2,
                                        Q=4, G=2), deep_waves=waves)
     drain_checked(cfg, length=30)

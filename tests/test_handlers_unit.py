@@ -36,7 +36,7 @@ def inbox(state, node):
     h, c = int(state.mb_head[node]), int(state.mb_count[node])
     for i in range(c):
         s = (h + i) % CFG.queue_capacity
-        row = state.mb_pack[node, s]
+        row = state.mb_pack[:, node, s]
         out.append(dict(type=Msg(int(row[MB_TYPE])),
                         sender=int(row[MB_SENDER]),
                         addr=int(row[MB_ADDR]),

@@ -151,3 +151,66 @@ def test_multi_txn_window_outcomes_are_reachable(name):
         f"{name}: txn_width=4 seeds {sorted(missing.values())} produced "
         f"final states outside the async outcome set "
         f"({len(s)} sync / {len(a)} async outcomes)")
+
+
+# Absorption-wave races: 3+ requesters funnel onto ONE remote entry in
+# a single round, in mixed read/write class sequences — the shapes the
+# wave-stamp fan-out encoding (ops/deep_engine, round 4) must resolve
+# per line: write-after-read downgrades-then-kills, read-after-write
+# spares the flushed writer as SHARED while pre-write holders die,
+# upgrade storms serialize through one entry, and a home chain
+# composes with foreign waves under the poison/clean rules.
+WAVE_CASES = {
+    "wave_wrw": [[(1, 0x30, 1)], [(0, 0x30, 0)], [(1, 0x30, 2)], []],
+    "wave_rrw": [[(0, 0x30, 0)], [(0, 0x30, 0)], [(1, 0x30, 7)], []],
+    "wave_rwr": [[(0, 0x30, 0)], [(1, 0x30, 3)], [(0, 0x30, 0)], []],
+    "wave_upgrade_storm": [
+        [(0, 0x30, 0), (1, 0x30, 4)],
+        [(0, 0x30, 0), (1, 0x30, 5)],
+        [(0, 0x30, 0), (1, 0x30, 6)], []],
+    # home 3's own chain on 0x30 (write) + foreign mixed waves, then a
+    # second own-entry touch (poison/clean arbitration paths)
+    "wave_home_chain": [
+        [(1, 0x30, 1)], [(0, 0x30, 0)], [(1, 0x30, 2)],
+        [(1, 0x30, 9), (0, 0x31, 0)]],
+    # displacement notice (0x31/0x21 share a cache slot) crossing a
+    # foreign read and write of the evicted entry
+    "wave_evict_mix": [
+        [(1, 0x31, 1), (0, 0x21, 0)], [(0, 0x31, 0)],
+        [(1, 0x31, 5)], []],
+}
+
+
+@pytest.mark.parametrize("waves", [1, 3])
+@pytest.mark.parametrize(
+    "name", sorted(WAVE_CASES) + ["migrate3", "upgrade_race",
+                                  "window_chain_race"])
+def test_deep_wave_outcomes_are_reachable(name, waves):
+    """Deep-window rounds with absorption waves (mixed classes) must
+    still land only in the message-level machine's outcome set."""
+    import dataclasses
+    traces = {**CASES, **WINDOW_CASES, **WAVE_CASES}[name]
+    # The deep engine serializes whole chains atomically, so its
+    # outcomes include SEQUENTIAL transaction orders — in the async
+    # machine those need issue-delay separations of a full transaction
+    # latency (~6 cycles/hop chain). Enumerate the union of a WIDE
+    # coarse grid (delays 0/6/12/18: whole-transaction orderings) and
+    # a TIGHT grid (delays 0/2/4: mid-flight interleavings); ranks
+    # cover same-cycle arbitration. A full fine product over 4 active
+    # nodes would be 8^4 x 24 runs — this union keeps the set rich and
+    # the test minutes-fast.
+    a = async_outcomes(SystemConfig.reference(), traces, max_delay=24,
+                       delay_step=6, n_ranks=12)
+    a.update(async_outcomes(SystemConfig.reference(), traces,
+                            max_delay=6, delay_step=2, n_ranks=12))
+    cfg = dataclasses.replace(
+        SystemConfig.reference(), deep_window=True, drain_depth=3,
+        txn_width=2, deep_slots=4, deep_ownerval_slots=2,
+        deep_waves=waves)
+    s = sync_outcomes(cfg, traces)
+    assert len(a) >= 1 and len(s) >= 1
+    missing = {fp: seed for fp, seed in s.items() if fp not in a}
+    assert not missing, (
+        f"{name}: deep waves={waves} seeds {sorted(missing.values())} "
+        f"produced final states outside the async outcome set "
+        f"({len(s)} deep / {len(a)} async outcomes)")

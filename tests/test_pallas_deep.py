@@ -63,6 +63,21 @@ def test_rounds_bit_identical_contended():
     se.check_exact_directory(pcfg, b)
 
 
+def test_rounds_bit_identical_waves():
+    """Absorption waves (deep_waves > 1, mixed classes) run under
+    either fold backend — the round middle is shared code
+    (deep_engine.round_step_deep), so only the fold kernels differ."""
+    cfg, pcfg = _cfgs(local_permille=200)
+    cfg = dataclasses.replace(cfg, deep_waves=3)
+    pcfg = dataclasses.replace(pcfg, deep_waves=3)
+    st = se.procedural_state(cfg, 200, seed=9)
+    st = se.run_rounds(cfg, st, 30)
+    a = se.run_rounds(cfg, st, 3)
+    b = se.run_rounds(pcfg, st, 3)
+    _assert_states_equal(a, b)
+    se.check_exact_directory(pcfg, b)
+
+
 @pytest.mark.skipif(jax.default_backend() != "tpu",
                     reason="compiled Pallas path needs the TPU backend "
                            "(CPU interpreter is impractically slow at "

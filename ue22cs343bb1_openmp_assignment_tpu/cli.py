@@ -143,6 +143,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="deep windows: remote-event slots per window "
                         "(default 8; on --resume an omitted flag keeps "
                         "the checkpoint's value)")
+    p.add_argument("--deep-waves", type=int, default=None,
+                   help="deep windows: absorption waves — up to this "
+                        "many fill requests (mixed read/write) compose "
+                        "per directory entry per round; the contended-"
+                        "workload lever (max 14; default 1; on "
+                        "--resume an omitted flag keeps the "
+                        "checkpoint's value)")
     p.add_argument("--sweep-seeds", type=int, metavar="K",
                    help="sync engine: run K arbitration seeds as one "
                         "vmapped ensemble and report which seeds "
@@ -216,7 +223,8 @@ def _main_sync(args) -> int:
                   "resume it without --engine sync", file=sys.stderr)
             return 2
         if (args.drain_depth is not None or args.txn_width is not None
-                or args.deep_window or args.deep_slots is not None):
+                or args.deep_window or args.deep_slots is not None
+                or args.deep_waves is not None):
             # pure compute knobs (window shape; no state shapes depend
             # on them) — overridable on resume like the async path's
             # admission/drop knobs
@@ -228,6 +236,8 @@ def _main_sync(args) -> int:
                 over["txn_width"] = args.txn_width
             if args.deep_window:
                 over["deep_window"] = True
+            if args.deep_waves is not None:
+                over["deep_waves"] = args.deep_waves
             if args.deep_slots is not None:
                 # an omitted --deep-slots keeps the checkpoint's slot
                 # count: the flag default is indistinguishable from an
@@ -247,6 +257,8 @@ def _main_sync(args) -> int:
             dims.update(deep_window=True,
                         deep_slots=(8 if args.deep_slots is None
                                     else args.deep_slots),
+                        deep_waves=(1 if args.deep_waves is None
+                                    else args.deep_waves),
                         txn_width=dims.get("txn_width", 3))
             dims.setdefault("drain_depth", 13)
         if args.procedural:

@@ -95,10 +95,11 @@ class SystemConfig:
     deep_horizon_slack: int = 2
     # absorption waves: per round, up to deep_waves foreign events
     # compose per directory entry (wave 0 = the classic one winner per
-    # entry; waves 1+ serialize additional FILL REQUESTS on flag-clean
-    # entries against the wave's composed row — the contended-workload
-    # lever, ops/deep_engine "absorption waves"). 1 = today's
-    # single-winner rounds.
+    # entry; waves 1+ serialize additional FILL REQUESTS — mixed
+    # read/write sequences included — against the previous wave's
+    # composed row; per-line outcomes stay exact via the wave-stamp
+    # fan-out encoding, ops/deep_engine). 1 = single-winner rounds.
+    # Capped at 14 by the 4-bit wave-stamp fields in DM_ACT.
     deep_waves: int = 1
 
     # Procedural workload (sync engine): when set (e.g. "uniform"),
@@ -148,6 +149,10 @@ class SystemConfig:
                 "column); num_nodes must be <= 65536")
         if self.txn_width < 1:
             raise ValueError("txn_width must be >= 1")
+        if not 1 <= self.deep_waves <= 14:
+            raise ValueError(
+                "deep_waves must be in [1, 14] (wave stamps pack into "
+                "4-bit DM_ACT fields; see ops/deep_engine)")
         if self.inv_mode not in ("mailbox", "scatter"):
             raise ValueError(f"bad inv_mode {self.inv_mode!r}")
         if self.inv_mode == "mailbox" and self.num_nodes > 64:

@@ -44,20 +44,31 @@ serialization of the reference machine):
    a fresh marker truncates the window at the hit (the home's kill or
    downgrade may not admit a consistent order with our later reads).
 3. **Request phase** — remote fill requests (RD/WR/UP) and eviction
-   notices (EV_S/EV_M) compose *after* the chains, at most one per
-   entry per round (scatter-min lane on DM_CLAIM, priority-first: a
-   node that wins one of its events this round wins all of them, so
-   crossed evict/fill pairs cannot starve each other). A winning fill
-   request reads the post-chain row and writes the composed row back;
-   this absorbs the common collision (home chain + one foreign
-   request both commit in one round). Owner values are read from the
-   owner's **cv_req snapshot** (its cache as of its own first
-   fill-request attempt), which keeps every observed value inside the
-   owner's pre-request stratum. Conflicts between a home's chain and
-   foreign events on its entries are resolved by a **priority total
-   order** — the lower-priority side gives way, mutually
-   consistently, so the global-minimum-priority node always advances
-   (the progress guarantee):
+   notices (EV_S/EV_M) compose *after* the chains: a wave-0 winner
+   per entry (scatter-min lane on DM_CLAIM, priority-first: a node
+   that wins one of its wave-0 events wins all of them, so crossed
+   evict/fill pairs cannot starve each other), then with
+   ``cfg.deep_waves > 1`` up to deep_waves - 1 further fill requests
+   per entry, each composing against the previous wave's committed
+   row (mixed read/write sequences included — per-line outcomes stay
+   exact through the wave-stamp fan-out encoding below). Waves
+   arbitrate sequentially under the same strict priority keys, so a
+   winning node keeps winning its later slots (whole windows commit
+   together) and a node's own same-entry events (re-touches) win in
+   program order by their slot-index key bits (measured: reshuffled
+   per-wave priorities, though fusable into one scatter, scatter the
+   wins across nodes and truncate everyone's window — strictly worse).
+   A winning fill request reads the latest row and
+   writes the composed row back; this absorbs the common collision
+   (home chain + foreign requests all committing in one round). Owner
+   values are read from the owner's **cv_req snapshot** (its cache as
+   of its own first fill-request attempt) — or, when the owner
+   acquired the line THIS round, from the round-value channel packed
+   into DM_REQ's high bits by the earlier wave's commit. Conflicts
+   between a home's chain and foreign events on its entries are
+   resolved by a **priority total order** — the lower-priority side
+   gives way, mutually consistently, so the global-minimum-priority
+   node always advances (the progress guarantee):
 
    * **marker vs notice** — a notice's evictor was a holder, so a
      same-round chain touch of its entry always set the home's dense
@@ -84,27 +95,39 @@ serialization of the reference machine):
    Marker and poison are *fold outputs of the home*, dense over its
    own slice — reshaping ``[N, S] -> [E]`` makes them gatherable with
    zero scatters; they are attempt-based (conservative), costing only
-   retries, never soundness. A lost lane, losing-priority abort, or
-   unsafe hit truncates retirement at its window position, so the
-   retired stream is always a program-order prefix.
+   retries, never soundness — with one sound relaxation: a requester
+   with NO attempted post-request own-row touches ("clean") cannot
+   sit inside any composition-order cycle, so its requests compose on
+   poisoned rows even when the home's priority wins. A lost lane,
+   losing-priority abort, or unsafe hit truncates retirement at its
+   window position, so the retired stream is always a program-order
+   prefix.
 4. **Fan-out** — kills/downgrades/promotions apply to holder lines by
    tag at round end, exactly like ops/sync_engine (the vectorized
-   INV / WRITEBACK_INT / EVICT_SHARED-promotion fan-outs). A request
-   composing after a chain merges the two actions by severity; the
-   request's effect on the home's own line is carried separately
-   (act_home) since the home is excluded from its own action.
+   INV / WRITEBACK_INT / EVICT_SHARED-promotion fan-outs). With
+   multiple winners per entry the single blanket action is replaced
+   by **wave stamps**: each entry records the wave of its last
+   committed write (kw) and last owner-downgrading read (dw), each
+   line records the wave it acquired in (aw; pre-round lines 0, the
+   chain 1, wave j at j + 2), and a line dies iff aw < kw, downgrades
+   iff aw < dw — so mixed read/write wave sequences resolve exactly
+   per line (a read after a write spares the flushed writer as SHARED
+   while pre-write holders die). The home's own line keeps an exact
+   2-bit composed action (act_h); promotions keep a pending bit with
+   promote-then-X overrides.
 
 Progress: a node's own-entry chains never lose arbitration, and the
 per-round reshuffled lane priority guarantees some requester wins each
 contended entry, so every trace drains (the runners assert the same
 claim-key round budget as ops/sync_engine).
 
-v1 simplifications (each truncates the window, costing rounds, never
-correctness): a write to a line the window filled by a remote *read*
-stops the window (the E/S fill ambiguity resolves in the committed
-cache by next round); re-touching a remote entry stops the window
-(own entries may be re-touched freely); slot-budget overflows stop
-the window.
+Remaining simplifications (each truncates the window, costing rounds,
+never correctness): a write to a line the window filled by a remote
+*read* stops the window (the E/S fill ambiguity resolves in the
+committed cache by next round); slot-budget overflows stop the
+window; with ``deep_waves == 1`` re-touching a remote entry stops the
+window (with waves, slot-indexed lane keys order same-entry
+re-touches across waves and the window proceeds).
 """
 
 from __future__ import annotations
@@ -128,45 +151,61 @@ K_NONE, K_RD, K_WR, K_UP, K_EVS, K_EVM, K_PROBE = 0, 1, 2, 3, 4, 5, 6
 # remote events — never scattered)
 F_MARK, F_POISON = 1, 2
 
-# fan-out actions; matching sync_engine codes, packed for deep rounds as
-# DM_ACT = (round << 4) | (act_home << 2) | act_other
+# fan-out actions; matching sync_engine codes. Deep rounds pack DM_ACT
+# as (round << 11) | (act_h << 9) | (promo << 8) | (kw << 4) | dw —
+# act_h is the exact 2-bit action for the home's own line, kw/dw are
+# the wave-stamp kill/downgrade thresholds, promo the pending-promotion
+# bit (see the dense-merge comment in round_step_deep)
 ACT_NONE, ACT_DOWN, ACT_KILL, ACT_PROMOTE = 0, 1, 2, 3
 
 _INT_MAX = jnp.iinfo(jnp.int32).max
 
 
-def _fold_deep(cfg: SystemConfig, st: SyncState, w_oa, w_val, w_live,
-               bad=None, ocode=None):
+def state_tiles(cfg: SystemConfig, st: SyncState):
+    """Transposed state views both fold backends consume: cache planes
+    [C, N], own-directory planes [S, N] (state/count/owner/mem)."""
+    N, S = cfg.num_nodes, 1 << cfg.block_bits
+    dm_own = st.dm.reshape(N, S, DM_COLS)
+    dm_t4 = tuple(dm_own[:, :, col].T
+                  for col in (DM_STATE, DM_COUNT, DM_OWNER, DM_MEM))
+    return st.cache_addr.T, st.cache_val.T, st.cache_state.T, dm_t4
+
+
+def _fold_deep(cfg: SystemConfig, st: SyncState, tiles, w_oa, w_val,
+               w_live, bad=None, ocode=None):
     """Drive the layout-neutral fold (ops.deep_fold) with a lax.scan
-    over window steps, in [N]-vec layout.
+    over window steps, in [N]-vec layout. Inputs and outputs use the
+    TRANSPOSED tile layout shared with the Pallas kernels (cache
+    [C, N], own-slice [S, N], slots [Q, N], window [W, N]) so neither
+    backend pays per-field transposes in the round middle.
 
     Pre-pass: bad/ocode None (attempt-everything, no truncation);
-    replay: bad [N, Q] slot verdicts + ocode [N, S] own-lane codes.
-    Returns the final carry with list fields stacked back to arrays.
-    A scan keeps the traced graph W-independent (in-loop backedges are
-    ~free on the bench device, while an unrolled fold's XLA compile
-    time exploded with W)."""
+    replay: bad [Q, N] slot verdicts + ocode [S, N] own-lane codes.
+    Returns the final carry with list fields stacked back to [rows, N]
+    arrays. A scan keeps the traced graph W-independent (in-loop
+    backedges are ~free on the bench device, while an unrolled fold's
+    XLA compile time exploded with W)."""
     N, C, S = cfg.num_nodes, cfg.cache_size, 1 << cfg.block_bits
     W = cfg.drain_depth + cfg.txn_width
     Q = cfg.deep_slots
+    ca_t, cv_t, cs_t, dm_t4 = tiles
     rows = jnp.arange(N, dtype=jnp.int32)
     zero = jnp.zeros((N,), jnp.int32)
     false = jnp.zeros((N,), bool)
-    dm_own = st.dm.reshape(N, S, DM_COLS)
     carry0 = deep_fold.fold_carry0(
         cfg,
-        ca=[st.cache_addr[:, i] for i in range(C)],
-        cv=[st.cache_val[:, i] for i in range(C)],
-        cs=[st.cache_state[:, i] for i in range(C)],
+        ca=[ca_t[i] for i in range(C)],
+        cv=[cv_t[i] for i in range(C)],
+        cs=[cs_t[i] for i in range(C)],
         dm_rows=dict(
-            dms=[dm_own[:, s, DM_STATE] for s in range(S)],
-            dmc=[dm_own[:, s, DM_COUNT] for s in range(S)],
-            dmo=[dm_own[:, s, DM_OWNER] for s in range(S)],
-            dmm=[dm_own[:, s, DM_MEM] for s in range(S)]),
+            dms=[dm_t4[0][s] for s in range(S)],
+            dmc=[dm_t4[1][s] for s in range(S)],
+            dmo=[dm_t4[2][s] for s in range(S)],
+            dmm=[dm_t4[3][s] for s in range(S)]),
         zero=zero, false=false)
-    badL = [zero] * Q if bad is None else [bad[:, q] for q in range(Q)]
+    badL = [zero] * Q if bad is None else [bad[q] for q in range(Q)]
     ocodeL = ([zero] * S if ocode is None
-              else [ocode[:, s] for s in range(S)])
+              else [ocode[s] for s in range(S)])
     horizon = st.horizon
 
     def body(c, x):
@@ -174,15 +213,15 @@ def _fold_deep(cfg: SystemConfig, st: SyncState, w_oa, w_val, w_live,
         return deep_fold.fold_step(cfg, c, rows, oa, val, live, k,
                                    horizon, badL, ocodeL), None
 
-    xs = (w_oa.T, w_val.T, w_live.T, jnp.arange(W, dtype=jnp.int32))
+    xs = (w_oa, w_val, w_live, jnp.arange(W, dtype=jnp.int32))
     fin, _ = jax.lax.scan(body, carry0, xs, length=W)
     out = dict(fin)
-    for f in ("ca", "cv", "cs", "cv_src", "rrf", "wf", "cv_req",
+    for f in ("ca", "cv", "cs", "cv_src", "rrf", "wf", "lwh", "cv_req",
               "cv_req_src", "dms", "dmc", "dmo", "dmm", "dmm_src",
               "touched", "act_acc", "mark", "poison", "kind", "ent",
               "sval", "pos", "comm", "rel", "relv", "reld", "g_owner",
               "g_ci"):
-        out[f] = jnp.stack(fin[f], axis=1)
+        out[f] = jnp.stack(fin[f], axis=0)
     out["cnt"] = dict(rd_miss=fin["c_rd"], wr_miss=fin["c_wr"],
                       upg=fin["c_up"], ev=fin["c_ev"])
     return out
@@ -190,8 +229,19 @@ def _fold_deep(cfg: SystemConfig, st: SyncState, w_oa, w_val, w_live,
 
 def round_step_deep(cfg: SystemConfig, st: SyncState,
                     with_events: bool = False,
-                    return_stats: bool = False):
+                    return_stats: bool = False,
+                    fold_impl: str = "xla"):
     """One deep-window round. See module docstring for the design.
+
+    ``fold_impl`` selects how the two W-step folds execute: ``"xla"``
+    (a lax.scan over deep_fold.fold_step in [N]-vec layout) or
+    ``"pallas"`` (ops.pallas_deep's fused TPU kernels in [1, T]
+    lane-row layout). The arbitration/composition/fan-out middle is
+    THIS function either way — the fold backends are bit-identical
+    (tests/test_pallas_deep.py), so the rounds are too. The middle
+    runs in the folds' transposed tile layout (slots [Q, N], own
+    slices [S, N], cache [C, N]) so neither backend pays per-field
+    transposes.
 
     ``with_events=True`` additionally returns the round's retirement
     record — per-node, per-window-step (op, addr, value, retired), the
@@ -207,6 +257,9 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     if with_events and return_stats:
         raise ValueError("with_events and return_stats are mutually "
                          "exclusive (one round returns one extra value)")
+    if return_stats and fold_impl != "xla":
+        raise ValueError("return_stats needs the XLA fold (the Pallas "
+                         "kernels do not export the anatomy fields)")
     N, C, S = cfg.num_nodes, cfg.cache_size, 1 << cfg.block_bits
     E = N * S
     W = cfg.drain_depth + cfg.txn_width
@@ -219,37 +272,53 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     SHD = int(CacheState.SHARED)
     D_U, D_S, D_EM = int(DirState.U), int(DirState.S), int(DirState.EM)
     rows = jnp.arange(N, dtype=jnp.int32)
+    dm_own = st.dm.reshape(N, S, DM_COLS)
+    tiles = state_tiles(cfg, st)
 
-    # ---- instruction window ---------------------------------------------
-    offs = jnp.arange(W, dtype=jnp.int32)[None, :]
-    w_idx = st.idx[:, None] + offs
-    w_live = w_idx < st.instr_count[:, None]
+    # ---- instruction window, [W, N] (shared with the Pallas kernels) ----
+    offs_w = jnp.arange(W, dtype=jnp.int32)[:, None]
+    w_idx = st.idx[None, :] + offs_w
+    w_live = w_idx < st.instr_count[None, :]
     if cfg.procedural:
-        w_oa, w_val = procedural_instr(cfg, rows[:, None], w_idx)
+        w_oa, w_val = procedural_instr(cfg, rows[None, :], w_idx)
     else:
-        w_flat = rows[:, None] * T + jnp.minimum(w_idx, T - 1)
+        w_flat = rows[None, :] * T + jnp.minimum(w_idx, T - 1)
         w = st.instr_pack.reshape(N * T, 2)[w_flat]
         w_oa, w_val = w[..., 0], w[..., 1]
 
     # ---- pre-pass fold (attempt everything) ------------------------------
-    pre = _fold_deep(cfg, st, w_oa, w_val, w_live)
-    kind, ent, sval = pre["kind"], pre["ent"], pre["sval"]
+    if fold_impl == "pallas":
+        from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_deep
+        pre = pallas_deep.fold_pre(cfg, st, tiles, w_oa, w_val, w_live)
+    else:
+        pre = _fold_deep(cfg, st, tiles, w_oa, w_val, w_live)
+    kind, ent, sval = pre["kind"], pre["ent"], pre["sval"]   # [Q, N]
     is_req = (kind == K_RD) | (kind == K_WR) | (kind == K_UP)
     is_ev = (kind == K_EVS) | (kind == K_EVM)
     is_probe = kind == K_PROBE
 
     # ---- lane scatter (requests + notices only) --------------------------
-    # lane key layout: [countdown | prio | ev_bit] — arbitration among
-    # same-round events is priority-first (a node that wins one of its
-    # events wins all of them, so crossed evict/fill pairs cannot
-    # starve each other), with the ev bit as a tiebreak tag that lets
-    # the chain-yield and probe rules tell notices from fill requests
+    # lane key layout: [countdown | prio | slot | ev_bit] — arbitration
+    # among same-round events is priority-first (a node that wins one
+    # of its events wins all of them, so crossed evict/fill pairs
+    # cannot starve each other). The slot bits (present only when
+    # deep_waves > 1) order a node's OWN same-entry events by program
+    # position, which is what makes same-entry re-touches (the old dup
+    # window stop) composable across waves; the ev bit is a tiebreak
+    # tag that lets the chain-yield and probe rules tell notices from
+    # fill requests.
     prio_bits = max(1, (N - 1).bit_length())
+    SB = 0 if cfg.deep_waves == 1 else max(1, (Q - 1).bit_length())
     rk = _round_key(cfg, st, rows)
     prio = rk & ((1 << prio_bits) - 1)
     countdown = rk >> prio_bits
-    key = (countdown << (prio_bits + 1)) | (prio << 1)       # fill key
-    key_q = jnp.where(is_ev, key[:, None] | 1, key[:, None])  # [N, Q]
+    key = ((countdown << (prio_bits + 1 + SB))
+           | (prio << (1 + SB)))                             # fill key
+    key_q = key[None, :]
+    if SB:
+        key_q = key_q | (jnp.arange(Q, dtype=jnp.int32)[:, None] << 1)
+    key_q = jnp.where(is_ev, key_q | 1,
+                      jnp.broadcast_to(key_q, (Q, N)))       # [Q, N]
     lane_idx = jnp.where(is_req | is_ev, ent, E).reshape(-1)
     dm_claimed = st.dm.at[lane_idx, DM_CLAIM].min(
         key_q.reshape(-1), mode="drop")
@@ -257,16 +326,17 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     # ---- gathers: lane-back + dense home flags (ONE fused gather) --------
     safe_ent = jnp.clip(ent, 0, E - 1)
     flags_arr = (pre["mark"].astype(jnp.int32) * F_MARK
-                 + pre["poison"].astype(jnp.int32) * F_POISON).reshape(E)
+                 + pre["poison"].astype(jnp.int32)
+                 * F_POISON).T.reshape(E)
     side = jnp.stack([dm_claimed[:, DM_CLAIM], flags_arr], axis=-1)
-    got2 = side[safe_ent]                                    # [N, Q, 2]
+    got2 = side[safe_ent]                                    # [Q, N, 2]
     lane_got, got_flags = got2[..., 0], got2[..., 1]
 
     # ---- truncation ------------------------------------------------------
     # fresh lane keys this round sit strictly below every stale key (the
     # DM_CLAIM countdown invariant, ops/sync_engine)
     thresh = (jnp.maximum(claim_max_rounds(cfg) - st.round, 0) + 1) \
-        << (prio_bits + 1)
+        << (prio_bits + 1 + SB)
     lane_fresh = lane_got < thresh
     lane_is_ev = (lane_got & 1) == 1
     won = lane_got == key_q
@@ -279,47 +349,57 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     # (conservative): aborting on a ghost touch costs a retry, never
     # soundness.
     pmask = (1 << prio_bits) - 1
-    prio_self = prio                                          # [N]
+    prio_self = prio[None, :]                                # [1, N]
     prio_home = _round_key(cfg, st, safe_ent >> cfg.block_bits) & pmask
-    home_wins = prio_home < prio_self[:, None]               # [N, Q]
-    aborting = ((is_req & ((got_flags & F_POISON) != 0) & home_wins)
+    home_wins = prio_home < prio_self                        # [Q, N]
+    # the clean-requester relaxation (round 4): the poison rule exists
+    # to break composition-order cycles, and every node in such a cycle
+    # must have an own-row touch at-or-after its own first fill-request
+    # attempt (the cycle's incoming edge composes on that touch). A
+    # node with NO such attempted touch — "clean" — cannot be inside
+    # any cycle, so its requests may compose on poisoned rows even when
+    # the home's priority wins. Computed from the pre-pass poison
+    # flags, which over-approximate the committed touches (replay is a
+    # prefix of the pre-pass), so clean is sound, not just heuristic.
+    clean_self = ~jnp.any(pre["poison"], axis=0)             # [N]
+    req_abort = (is_req & ((got_flags & F_POISON) != 0) & home_wins
+                 & ~clean_self[None, :])
+    aborting = (req_abort
                 | (is_ev & ((got_flags & F_MARK) != 0) & home_wins))
     # ---- absorption waves (cfg.deep_waves > 1) ---------------------------
     # extra per-entry winners: after the wave-0 lane, up to
     # deep_waves-1 additional FILL REQUESTS commit per entry, each
-    # composing against the previous wave's row. Restricted to
-    # flag-clean entries (no chain conflict -> no order-cycle risk; a
-    # chain-touched entry with any foreign interest always carries
-    # mark/poison, so clean == chain-untouched) and to requests
-    # (notices stay single-wave: a notice composing after a same-round
-    # foreign event has no legal serialization). Lost-in-all-waves
-    # feeds the replay fold's truncation exactly like a wave-0 loss.
+    # composing against the previous wave's row (mixed read/write
+    # sequences included — the wave-stamp fan-out encoding below keeps
+    # per-line outcomes exact for any class sequence). Eligibility is
+    # exactly "not poison-aborted": a poisoned entry's ~home_wins
+    # candidates are safe because the chain-yield signal rides the
+    # wave-0 lane MINIMUM key, which bounds every candidate's priority
+    # from below — if any candidate beats the home, so does the lane
+    # minimum, and the chain yields; home_wins candidates compose only
+    # when clean (no cycle, see above). Notices stay single-wave (a
+    # notice composing after a same-round foreign event has no legal
+    # serialization). Lost-in-all-waves feeds the replay fold's
+    # truncation exactly like a wave-0 loss.
     won_list = [won]
     won_any = won
-    if cfg.deep_waves > 1:
-        # class homogeneity: all of an entry's wave commits must be the
-        # same class as its wave-0 winner — write-like chains (each
-        # write kills every earlier holder, so the single composed KILL
-        # act is exact) or read-like chains (downgrades only). A MIXED
-        # sequence (write then read) has no single-act fan-out
-        # encoding: the flushed writer must survive as SHARED while
-        # pre-write holders die. Mixed pairs keep wave-0-only behavior.
-        wlike_kind = (kind == K_WR) | (kind == K_UP)
-        wclass = jnp.zeros((E,), jnp.int32).at[
-            jnp.where(won & (is_req | is_ev), ent, E).reshape(-1)].set(
-            jnp.where(wlike_kind, 2, 1).reshape(-1), mode="drop")
-        got_class = wclass[safe_ent]
-        for _ in range(cfg.deep_waves - 1):
-            cand = (is_req & (got_flags == 0) & ~won_any
-                    & (jnp.where(wlike_kind, 2, 1) == got_class))
-            wave_idx = jnp.where(cand, ent, E).reshape(-1)
-            lane_j = jnp.full((E,), _INT_MAX, jnp.int32).at[
-                wave_idx].min(key_q.reshape(-1), mode="drop")
-            won_j = cand & (lane_j[safe_ent] == key_q)
-            won_list.append(won_j)
-            won_any = won_any | won_j
-    req_bad = is_req & (~won_any | (((got_flags & F_POISON) != 0)
-                                    & home_wins))
+    for _ in range(cfg.deep_waves - 1):
+        # sequential wave arbitration under the SAME strict priority
+        # keys: each wave's min over the not-yet-won candidates picks
+        # the next winner per entry, so a high-priority node still
+        # wins ALL its slots across consecutive waves (the window
+        # coherence that lets whole windows commit together), and a
+        # node's own same-entry events win in program order by their
+        # slot-index key bits alone (same node => same priority, so
+        # the earlier slot's lower key wins the earlier wave).
+        cand = is_req & ~req_abort & ~won_any
+        wave_idx = jnp.where(cand, ent, E).reshape(-1)
+        lane_j = jnp.full((E,), _INT_MAX, jnp.int32).at[
+            wave_idx].min(key_q.reshape(-1), mode="drop")
+        won_j = cand & (lane_j[safe_ent] == key_q)
+        won_list.append(won_j)
+        won_any = won_any | won_j
+    req_bad = is_req & (~won_any | req_abort)
     ev_bad = is_ev & (~won | (((got_flags & F_MARK) != 0)
                               & home_wins))
     # probes: a fresh marker (the entry's home chain-transacted on it)
@@ -329,7 +409,7 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     # eviction notices never endanger a hit
     probe_bad = is_probe & (((got_flags & F_MARK) != 0)
                             | ((sval != 0) & lane_fresh & ~lane_is_ev))
-    bad = (req_bad | ev_bad | probe_bad).astype(jnp.int32)   # [N, Q]
+    bad = (req_bad | ev_bad | probe_bad).astype(jnp.int32)   # [Q, N]
     # chain-yield codes (dense own-slice reads — own entries are never
     # our own lane targets, so any fresh key there is foreign). The
     # yield rules themselves run inside the replay fold
@@ -337,52 +417,70 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     # yields to a winning fresh notice at any position and to a winning
     # fresh fill request after our first request attempt; post-request
     # own HITS yield to fresh fill requests.
-    own_lane = dm_claimed.reshape(N, S, DM_COLS)[:, :, DM_CLAIM]
-    o_fresh = own_lane < thresh                              # [N, S]
+    own_lane = dm_claimed.reshape(N, S, DM_COLS)[:, :, DM_CLAIM].T
+    o_fresh = own_lane < thresh                              # [S, N]
     o_ev = (own_lane & 1) == 1
-    o_beats = ((own_lane >> 1) & pmask) < prio_self[:, None]  # sender wins
+    o_beats = ((own_lane >> (1 + SB)) & pmask) < prio[None, :]  # sender wins
     # per-entry code bits, deep_fold.OC_*: 1 = fresh, 2 = fresh EV,
     # 4 = fresh & sender beats the home's priority
     o_code = (o_fresh.astype(jnp.int32) * deep_fold.OC_FRESH
               | (o_fresh & o_ev).astype(jnp.int32) * deep_fold.OC_EV
               | (o_fresh & o_beats).astype(jnp.int32)
-              * deep_fold.OC_BEATS)                          # [N, S]
+              * deep_fold.OC_BEATS)                          # [S, N]
 
     # ---- replay fold (committed prefix) ----------------------------------
     # the fold truncates retirement at the first bad slot or
     # yield-unsafe own touch; rp["comm"] marks the slots that committed
-    rp = _fold_deep(cfg, st, w_oa, w_val, w_live, bad=bad, ocode=o_code)
+    if fold_impl == "pallas":
+        rp = pallas_deep.fold_replay(cfg, st, tiles, w_oa, w_val,
+                                     w_live, bad, o_code)
+    else:
+        rp = _fold_deep(cfg, st, tiles, w_oa, w_val, w_live, bad=bad,
+                        ocode=o_code)
 
     # ---- dense merge of own rows -----------------------------------------
-    rtag = st.round << 4
+    # DM_ACT packing (round 4, wave-stamp fan-out): (round << 11) |
+    # (act_h << 9) | (promo << 8) | (kw << 4) | dw. act_h is the 2-bit
+    # composed action for the HOME's own line (exact, per-line); kw/dw
+    # are wave STAMPS — a tag-matching holder line dies iff it acquired
+    # before stamp kw (aw < kw), downgrades to SHARED iff aw < dw.
+    # Stamps: 0 = none, 1 = the home's chain, j + 2 = absorption wave
+    # j. Per-line acquisition stamps aw live in a round-local [C, N]
+    # array (pre-round lines 0, wave-j fills j + 2), so mixed
+    # read/write wave sequences resolve exactly: each holder compares
+    # its own acquisition against the stamps instead of sharing one
+    # blanket action.
+    rtag = st.round << 11
+    acc = rp["act_acc"]                                      # [S, N]
+    touched = rp["touched"]
     act_col = jnp.where(
-        rp["touched"],
-        rtag | rp["act_acc"],                 # act_home=0 for chain rows
-        dm_own_col(st, DM_ACT, N, S))
+        touched,
+        rtag
+        | (acc == ACT_PROMOTE).astype(jnp.int32) << 8
+        | (acc == ACT_KILL).astype(jnp.int32) << 4
+        | (acc == ACT_DOWN).astype(jnp.int32),
+        dm_own[:, :, DM_ACT].T)
     # g-slot owner values from the committed cache (phase-H writes only
     # can precede — mid-window foreign hit-writes on marked entries
     # truncate, so cv_post is the serialization-consistent source)
-    g_flat = jnp.clip(rp["g_owner"], 0, N - 1) * C + rp["g_ci"]
-    g_vals = rp["cv_req"].reshape(-1)[g_flat]                # [N, G]
+    g_flat = rp["g_ci"] * N + jnp.clip(rp["g_owner"], 0, N - 1)
+    g_vals = rp["cv_req"].reshape(-1)[g_flat]                # [G, N]
     dmm_m = rp["dmm"]
     cv_m = rp["cv"]
     cv_req_m = rp["cv_req"]
     for g in range(G):
-        dmm_m = jnp.where(rp["dmm_src"] == g, g_vals[:, g:g + 1], dmm_m)
-        cv_m = jnp.where(rp["cv_src"] == g, g_vals[:, g:g + 1], cv_m)
-        cv_req_m = jnp.where(rp["cv_req_src"] == g, g_vals[:, g:g + 1],
+        dmm_m = jnp.where(rp["dmm_src"] == g, g_vals[g:g + 1], dmm_m)
+        cv_m = jnp.where(rp["cv_src"] == g, g_vals[g:g + 1], cv_m)
+        cv_req_m = jnp.where(rp["cv_req_src"] == g, g_vals[g:g + 1],
                              cv_req_m)
     merged = jnp.stack([
-        jnp.where(rp["touched"], rp["dms"],
-                  dm_own_col(st, DM_STATE, N, S)),
-        jnp.where(rp["touched"], rp["dmc"],
-                  dm_own_col(st, DM_COUNT, N, S)),
-        jnp.where(rp["touched"], rp["dmo"],
-                  dm_own_col(st, DM_OWNER, N, S)),
-        jnp.where(rp["touched"], dmm_m, dm_own_col(st, DM_MEM, N, S)),
-        act_col,
-        jnp.where(rp["touched"], jnp.broadcast_to(rows[:, None], (N, S)),
-                  dm_own_col(st, DM_REQ, N, S)),
+        jnp.where(touched, rp["dms"], dm_own[:, :, DM_STATE].T).T,
+        jnp.where(touched, rp["dmc"], dm_own[:, :, DM_COUNT].T).T,
+        jnp.where(touched, rp["dmo"], dm_own[:, :, DM_OWNER].T).T,
+        jnp.where(touched, dmm_m, dm_own[:, :, DM_MEM].T).T,
+        act_col.T,
+        jnp.where(touched, jnp.broadcast_to(rows[None, :], (S, N)),
+                  dm_own[:, :, DM_REQ].T).T,
         dm_claimed.reshape(N, S, DM_COLS)[:, :, DM_CLAIM],
     ], axis=-1).reshape(E, DM_COLS)
     dm = merged
@@ -394,41 +492,43 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     # round-value array `rv` so later-wave reads/writes on the same
     # entry source the in-flight value (memory is NOT written by
     # write-allocate, quirk; cv_req cannot see this round's fills).
-    r_ci = codec.cache_index(cfg, safe_ent)
-    req_id = jnp.broadcast_to(rows[:, None], (N, Q))
-    c_iota = jnp.arange(C, dtype=jnp.int32)[None, :]
-    ca_c, cv_c, cs_c = rp["ca"], cv_m, rp["cs"]
-    # round-value array: bit 8 = owner wrote this round (bits 0-7 the
-    # value); bit 9 = owner acquired CLEAN this round (read fill — its
-    # value IS the row's memory). Later waves source owner values from
-    # here; cv_req cannot see this round's fills.
-    rv = jnp.zeros((E,), jnp.int32)
-    commit_acc = jnp.zeros((N, Q), bool)
-    rel_acc = jnp.zeros((N, Q), bool)
-    patch_acc = jnp.zeros((N, Q), bool)
-    fille_acc = jnp.zeros((N, Q), bool)
-    fillv_acc = jnp.zeros((N, Q), jnp.int32)
+    r_ci = codec.cache_index(cfg, safe_ent)                  # [Q, N]
+    req_id = jnp.broadcast_to(rows[None, :], (Q, N))
+    commit_acc = jnp.zeros((Q, N), bool)
+    rel_acc = jnp.zeros((Q, N), bool)
+    patch_acc = jnp.zeros((Q, N), bool)
+    fille_acc = jnp.zeros((Q, N), bool)
+    fillv_acc = jnp.zeros((Q, N), jnp.int32)
+    aw_acc = jnp.zeros((Q, N), jnp.int32)   # per-slot acquisition stamp
     for j, won_j in enumerate(won_list):
+        stamp = j + 2                       # chain = 1, wave j = j + 2
         commit = (is_req | is_ev) & won_j & rp["comm"]
         commit_acc = commit_acc | commit
-        g_rows = dm[safe_ent]                                # [N, Q, cols]
+        g_rows = dm[safe_ent]                                # [Q, N, cols]
         r_state = g_rows[..., DM_STATE]
         r_cnt = g_rows[..., DM_COUNT]
         r_own = g_rows[..., DM_OWNER]
         r_mem = g_rows[..., DM_MEM]
         r_act = g_rows[..., DM_ACT]
         # a pending row (same-round promotion, owner == -1) serves its
-        # memory as the owner value: SHARED lines are clean, and the
-        # promoted-E line's value equals mem
+        # memory as the owner value: SHARED lines are clean in this
+        # protocol, and the promoted-E line's value equals mem
         r_pend = (r_state == D_EM) & (r_own == -1)
+        prev_fresh = (r_act >> 11) == st.round
+        # the round-value channel rides DM_REQ's high bits (written by
+        # earlier waves' commit scatters): bit 8 = owner wrote this
+        # round (bits 0-7 its value — write-allocate leaves memory
+        # stale, and cv_req cannot see this round's fills), bit 9 =
+        # memory already holds the owner's current value (clean
+        # acquisition or a flushed release)
+        rv_got = jnp.where(prev_fresh,
+                           (g_rows[..., DM_REQ] >> 16) & 0x3FF, 0)
         own_val = jnp.where(
             r_pend, r_mem,
-            cv_req_m.reshape(-1)[jnp.clip(r_own, 0, N - 1) * C + r_ci])
-        if j > 0:
-            rv_got = rv[safe_ent]
-            own_val = jnp.where((rv_got & 0x200) != 0, r_mem, own_val)
-            own_val = jnp.where((rv_got & 0x100) != 0, rv_got & 0xFF,
-                                own_val)
+            cv_req_m.reshape(-1)[r_ci * N + jnp.clip(r_own, 0, N - 1)])
+        own_val = jnp.where((rv_got & 0x200) != 0, r_mem, own_val)
+        own_val = jnp.where((rv_got & 0x100) != 0, rv_got & 0xFF,
+                            own_val)
         r_u = r_state == D_U
         r_s = r_state == D_S
         r_em = r_state == D_EM
@@ -481,31 +581,21 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
                                          jnp.where(r_em, own_val,
                                                    r_mem)),
                           n_mem)
-        # fan-out action composition, split by target: the home's own
-        # line takes act_h, every other tag-matching holder act_o.
-        # Downgrade/promote target the row's recorded owner, which may
-        # or may not be the home's line.
+        # ---- wave-stamp act composition (see dense-merge comment) -------
+        prev_ah = jnp.where(prev_fresh, (r_act >> 9) & 3, ACT_NONE)
+        prev_promo = prev_fresh & (((r_act >> 8) & 1) == 1)
+        prev_kw = jnp.where(prev_fresh, (r_act >> 4) & 15, 0)
+        prev_dw = jnp.where(prev_fresh, r_act & 15, 0)
         tgt_home = r_own == (safe_ent >> cfg.block_bits)
+        plain_rd = k_rd & ~rel
+        # the home's own line keeps an exact 2-bit composed action
+        # (unique line, so promote-then-X composition stays explicit)
         my_h = jnp.where(wlike, ACT_KILL,
                 jnp.where(k_rd & r_em & tgt_home,
                           jnp.where(rel, ACT_PROMOTE, ACT_DOWN),
                  jnp.where(k_evs & r_s & (evs_cnt == 1), ACT_PROMOTE,
                            ACT_NONE)))
-        my_o = jnp.where(wlike, ACT_KILL,
-                jnp.where(k_rd & r_em & ~tgt_home,
-                          jnp.where(rel, ACT_PROMOTE, ACT_DOWN),
-                 jnp.where(k_evs & r_s & (evs_cnt == 1), ACT_PROMOTE,
-                           ACT_NONE)))
-        chain_fresh = (r_act >> 4) == st.round
-        chain_act = jnp.where(chain_fresh, r_act & 3, ACT_NONE)
-        prev_ah = jnp.where(chain_fresh, (r_act >> 2) & 3, ACT_NONE)
-        # promote-then-X overrides: a plain read nets a DOWNGRADE (the
-        # promotee may be an old E/M owner — the one composed action
-        # must still take its line to SHARED); a released read
-        # re-promotes; a write kills; a notice means the promotee
-        # itself evicted. The same composition applies to the home's
-        # own action across waves (prev_ah is 0 for chain rows, so
-        # wave 0 reduces to act_h = my_h).
+
         def _compose(prev, mine):
             return jnp.where(prev == ACT_PROMOTE,
                              jnp.where(wlike, ACT_KILL,
@@ -513,65 +603,111 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
                                                  jnp.where(k_rd, ACT_DOWN,
                                                            ACT_NONE))),
                              jnp.maximum(prev, mine))
-        act_o = _compose(chain_act, my_o)
         act_h = _compose(prev_ah, my_h)
-        n_act = rtag | (act_h << 2) | act_o
+        # all other holders resolve against wave stamps: a committed
+        # write kills every line acquired before it (aw < kw); a plain
+        # read of an EM row downgrades every earlier acquirer
+        # (aw < dw) — exactly the current owner plus already-dead
+        # lines; promote persists until a later event overrides it
+        # (promote-then-read nets a downgrade of the unnamed promotee,
+        # promote-then-write kills it, a notice cancels it)
+        n_kw = jnp.where(wlike, stamp, prev_kw)
+        n_dw = jnp.where(plain_rd & r_em & ~tgt_home, stamp, prev_dw)
+        promo_set = ((k_evs & r_s & (evs_cnt == 1))
+                     | (k_rd & rel & r_em & ~tgt_home))
+        promo_clr = wlike | k_evs | k_evm | (plain_rd & r_em)
+        n_promo = jnp.where(promo_set, True,
+                            jnp.where(promo_clr, False, prev_promo))
+        n_act = (rtag | (act_h << 9)
+                 | (n_promo.astype(jnp.int32) << 8)
+                 | (n_kw << 4) | n_dw)
+        rv_new = jnp.where(wlike & ~rel, 0x100 | (sval & 0xFF),
+                  jnp.where((k_rd & r_u & ~rel)
+                            | (k_rd & rel & r_em), 0x200, 0))
         t_idx = jnp.where(commit, safe_ent, E).reshape(-1)
         t_rows = jnp.stack(
-            [n_state, n_cnt, n_own, n_mem, n_act, req_id, key_q],
+            [n_state, n_cnt, n_own, n_mem, n_act,
+             req_id | (rv_new << 16), key_q],
             axis=-1).reshape(-1, DM_COLS)
         dm = dm.at[t_idx].set(t_rows, mode="drop")
-        if j + 1 < len(won_list):
-            rv = rv.at[jnp.where(wlike, safe_ent, E).reshape(-1)].set(
-                (0x100 | (sval & 0xFF)).reshape(-1), mode="drop")
-            rv = rv.at[jnp.where(k_rd & r_u & ~rel, safe_ent,
-                                 E).reshape(-1)].set(0x200, mode="drop")
 
         # reply patches on the requester's cache: committed remote rd
         # fills resolve E vs S and the fill value here. Accumulated
         # across waves (commits are slot-disjoint) and applied after
         # the loop in WINDOW-SLOT order — a node may commit fills on
         # the same cache index in different waves, and the later
-        # window slot must land last.
+        # window slot must land last. aw_acc records each committed
+        # fill slot's acquisition stamp for the fan-out.
         fill_e = k_rd & r_u
-        fill_val = jnp.where(r_em, own_val, r_mem)
-        patch = k_rd & ~rel      # a released fill's line was displaced
+        fill_val = jnp.where(wlike, sval,
+                             jnp.where(r_em, own_val, r_mem))
+        # write-like slots patch their own written value too (equal to
+        # the fold's — idempotent) so that, applied in window-slot
+        # order, they cancel any EARLIER read-fill patch on the same
+        # line (rd-then-upgrade pairs on one entry, the speculative-
+        # upgrade path); released slots' lines were displaced
+        patch = (k_rd | wlike) & ~rel
         patch_acc = patch_acc | patch
         fille_acc = fille_acc | fill_e
         fillv_acc = jnp.where(patch, fill_val, fillv_acc)
+        aw_acc = jnp.where(commit & is_req & ~rel, stamp, aw_acc)
+    ca_rows = [rp["ca"][c:c + 1] for c in range(C)]
+    cv_rows = [cv_m[c:c + 1] for c in range(C)]
+    cs_rows = [rp["cs"][c:c + 1] for c in range(C)]
+    aw_rows = [jnp.zeros((1, N), jnp.int32) for _ in range(C)]
     for q in range(Q):
-        oh = (r_ci[:, q][:, None] == c_iota) & patch_acc[:, q][:, None]
-        cs_c = jnp.where(oh & fille_acc[:, q][:, None], EXC, cs_c)
-        cv_c = jnp.where(oh, fillv_acc[:, q][:, None], cv_c)
+        m_q = patch_acc[q:q + 1]
+        rci_q = r_ci[q:q + 1]
+        fe_q, fv_q = fille_acc[q:q + 1], fillv_acc[q:q + 1]
+        s_q = (aw_acc[q] > 0)[None, :]
+        st_q = aw_acc[q:q + 1]
+        for c in range(C):
+            # lwh: a write HIT followed the line's last fill, so the
+            # fold's value is newest — no patch may touch it
+            oh = (rci_q == c) & m_q & ~rp["lwh"][c:c + 1]
+            cs_rows[c] = jnp.where(oh & fe_q, EXC, cs_rows[c])
+            cv_rows[c] = jnp.where(oh, fv_q, cv_rows[c])
+            ohs = (rci_q == c) & s_q
+            aw_rows[c] = jnp.where(ohs, st_q, aw_rows[c])
+    ca_c = jnp.concatenate(ca_rows, axis=0)                  # [C, N]
+    cv_c = jnp.concatenate(cv_rows, axis=0)
+    cs_c = jnp.concatenate(cs_rows, axis=0)
+    aw = jnp.concatenate(aw_rows, axis=0)
 
     # ---- fan-out ---------------------------------------------------------
-    # act + req pack into ONE dense [E] column (bit 20 = fresh, bits
-    # 16-19 = act nibble, bits 0-15 = requester id; num_nodes <= 65536
-    # by the deep-window address-width cap), so the per-line gather
-    # reads 1 column instead of the 7-column row
-    line_e = jnp.clip(ca_c, 0, E - 1)
-    fan_fresh = (dm[:, DM_ACT] >> 4) == st.round
+    # per-entry packed word, gathered once per cached line: bit 27
+    # fresh, 25-26 act_h, 24 promo, 20-23 kw, 16-19 dw, 0-15 requester
+    # id (num_nodes <= 65536 by the deep-window address-width cap).
+    # Non-home lines compare their acquisition stamp aw against kw/dw;
+    # the home's line applies the exact act_h.
+    line_e = jnp.clip(ca_c, 0, E - 1)                        # [C, N]
+    fan_fresh = (dm[:, DM_ACT] >> 11) == st.round
     fan_packed = (jnp.where(fan_fresh,
-                            ((dm[:, DM_ACT] & 15) | 16) << 16, 0)
-                  | dm[:, DM_REQ])
-    line_f = fan_packed[line_e]                              # [N, C]
-    fresh = ((line_f >> 20) & 1) == 1
-    l_act_h = jnp.where(fresh, (line_f >> 18) & 3, ACT_NONE)
-    l_act_o = jnp.where(fresh, (line_f >> 16) & 3, ACT_NONE)
+                            ((dm[:, DM_ACT] & 0x7FF) | 0x800) << 16, 0)
+                  | (dm[:, DM_REQ] & 0xFFFF))
+    line_f = fan_packed[line_e]                              # [C, N]
+    fresh = ((line_f >> 27) & 1) == 1
+    l_ah = jnp.where(fresh, (line_f >> 25) & 3, ACT_NONE)
+    l_promo = fresh & (((line_f >> 24) & 1) == 1)
+    l_kw = jnp.where(fresh, (line_f >> 20) & 15, 0)
+    l_dw = jnp.where(fresh, (line_f >> 16) & 15, 0)
     l_req = line_f & 0xFFFF
     l_home = line_e >> cfg.block_bits
-    i_am_home = l_home == rows[:, None]
-    a_code = jnp.where(i_am_home, l_act_h, l_act_o)
+    i_am_home = l_home == rows[None, :]
     valid = cs_c != INV
-    not_self = l_req != rows[:, None]
-    kill = valid & not_self & (a_code == ACT_KILL)
-    down = valid & not_self & (a_code == ACT_DOWN)
-    promo = valid & not_self & (a_code == ACT_PROMOTE)
+    not_self = l_req != rows[None, :]
+    kill = valid & jnp.where(i_am_home, l_ah == ACT_KILL, aw < l_kw)
+    promo = valid & ~kill & jnp.where(i_am_home, l_ah == ACT_PROMOTE,
+                                      l_promo & not_self)
+    down = valid & ~kill & ~promo & jnp.where(i_am_home,
+                                              l_ah == ACT_DOWN,
+                                              aw < l_dw)
     cs_c = jnp.where(kill, INV,
-                     jnp.where(down, SHD,
-                               jnp.where(promo, EXC, cs_c)))
+                     jnp.where(promo, EXC,
+                               jnp.where(down, SHD, cs_c)))
     dm = dm.at[jnp.where(promo, line_e, E).reshape(-1), DM_OWNER].set(
-        jnp.broadcast_to(rows[:, None], (N, C)).reshape(-1), mode="drop")
+        jnp.broadcast_to(rows[None, :], (C, N)).reshape(-1),
+        mode="drop")
 
     # ---- bookkeeping -----------------------------------------------------
     # replay counters already include retired *remote* transactions (a
@@ -583,10 +719,10 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
         cntr["rd_miss"],
         cntr["wr_miss"],
         cntr["upg"],
-        jnp.sum((is_req | is_ev) & ~won_any, axis=1, dtype=jnp.int32),
+        jnp.sum((is_req | is_ev) & ~won_any, axis=0, dtype=jnp.int32),
         cntr["ev"],
-        jnp.sum(kill, axis=1, dtype=jnp.int32),
-        jnp.sum(promo, axis=1, dtype=jnp.int32),
+        jnp.sum(kill, axis=0, dtype=jnp.int32),
+        jnp.sum(promo, axis=0, dtype=jnp.int32),
     ]), axis=1)
     mt = st.metrics
     metrics = mt.replace(
@@ -602,7 +738,8 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
         invalidations=mt.invalidations + deltas[8],
         promotions=mt.promotions + deltas[9],
     )
-    out = st.replace(cache_addr=ca_c, cache_val=cv_c, cache_state=cs_c,
+    out = st.replace(cache_addr=ca_c.T, cache_val=cv_c.T,
+                     cache_state=cs_c.T,
                      dm=dm, idx=st.idx + rp["n_ret"],
                      horizon=jnp.clip(
                          rp["n_ret"] + cfg.deep_horizon_slack, 2,
@@ -621,15 +758,15 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
             abort_poison=s_(aborting & is_req),
             abort_mark=s_(aborting & is_ev),
             probe_bad=s_(probe_bad),
-            committed=s_(commit_acc), released=s_(rel_acc))
+            committed=s_(commit_acc), released=s_(rel_acc),
+            clean=s_(clean_self),
+            stop_overq=s_(rp["s_overq"]), stop_overg=s_(rp["s_overg"]),
+            stop_dup=s_(rp["s_dup"]), stop_dep=s_(rp["s_dep"]),
+            stop_live=s_(rp["s_live"]))
         return out, stats
     if not with_events:
         return out
-    events = {"retired": offs < rp["n_ret"][:, None],   # [N, W]
-              "op": w_oa >> 28, "addr": w_oa & 0x0FFFFFFF,
-              "value": w_val}
+    events = {"retired": offs_w.T < rp["n_ret"][:, None],   # [N, W]
+              "op": w_oa.T >> 28, "addr": w_oa.T & 0x0FFFFFFF,
+              "value": w_val.T}
     return out, events
-
-
-def dm_own_col(st: SyncState, col: int, N: int, S: int):
-    return st.dm.reshape(N, S, DM_COLS)[:, :, col]

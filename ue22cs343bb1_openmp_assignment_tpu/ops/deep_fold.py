@@ -74,6 +74,7 @@ def fold_carry0(cfg: SystemConfig, ca, cv, cs, dm_rows, zero, false):
     return dict(
         ca=list(ca), cv=list(cv), cs=list(cs),
         cv_src=[neg1] * C, rrf=[false] * C, wf=[false] * C,
+        lwh=[false] * C,
         dms=list(dm_rows["dms"]), dmc=list(dm_rows["dmc"]),
         dmo=list(dm_rows["dmo"]), dmm=list(dm_rows["dmm"]),
         dmm_src=[neg1] * S,
@@ -84,6 +85,8 @@ def fold_carry0(cfg: SystemConfig, ca, cv, cs, dm_rows, zero, false):
         n_slot=zero, n_g=zero, seen_req=false,
         n_ret=zero, rh=zero, wh=zero,
         c_rd=zero, c_wr=zero, c_up=zero, c_ev=zero,
+        s_overq=false, s_overg=false, s_dup=false, s_dep=false,
+        s_live=false,
         kind=[zero] * Q, ent=[zero] * Q, sval=[zero] * Q,
         pos=[zero + W] * Q, comm=[false] * Q,
         rel=[false] * Q, relv=[zero] * Q, reld=[false] * Q,
@@ -132,8 +135,23 @@ def fold_step(cfg: SystemConfig, c, node, oa, val, live, k, horizon,
     wr_hit = live & is_wr & tag_ok & ((l_state == MOD) | (l_state == EXC))
     wr_sh = live & is_wr & tag_ok & (l_state == SHD)
     nop = live & (op == int(Op.NOP))
-    dep_stop = wr_sh & l_rrf                  # v1: resolve next round
-    upg = wr_sh & ~l_rrf
+    if cfg.deep_waves == 1:
+        # single-wave: a write on a line this window filled by a
+        # remote READ stops the window (the E/S fill resolution lands
+        # in the committed cache next round)
+        dep_stop = wr_sh & l_rrf
+        upg = wr_sh & ~l_rrf
+    else:
+        # speculative upgrade (waves >= 2): issue an UPGRADE slot
+        # regardless of the unresolved E/S fill — on an S row it is
+        # the normal upgrade; on an EM{self} row it composes to the
+        # exact state the reference's silent E-write leaves (the
+        # UPGRADE handler's unconditional dir -> EM{requester},
+        # assignment.c:325-349), costing one slot. Needs waves: the
+        # slot shares its entry with the window's own read-fill slot,
+        # which only the slot-indexed wave keys can order.
+        dep_stop = jnp.zeros_like(wr_sh)
+        upg = wr_sh
     rd_miss = live & is_rd & ~tag_ok
     wr_miss = live & is_wr & ~tag_ok
     is_txn = (upg | rd_miss | wr_miss) & ~dep_stop
@@ -168,10 +186,16 @@ def fold_step(cfg: SystemConfig, c, node, oa, val, live, k, horizon,
         rel_any_all = rel_any_all | rh_
     rel_any = rel_any_all & rem_vic
     dup_t = dup_v = jnp.zeros_like(live)
-    for kk, ee in zip(c["kind"], c["ent"]):
-        isrem = (kk >= K_RD) & (kk <= K_EVM)
-        dup_t = dup_t | (isrem & (ee == addr))
-        dup_v = dup_v | (isrem & (ee == l_addr))
+    if cfg.deep_waves == 1:
+        # single-wave rounds: a second remote event on an already-
+        # slotted entry cannot commit (one winner per entry), so stop
+        # the window there. With waves > 1 the slot-indexed lane keys
+        # order a node's same-entry events across waves
+        # (ops/deep_engine), so re-touches proceed.
+        for kk, ee in zip(c["kind"], c["ent"]):
+            isrem = (kk >= K_RD) & (kk <= K_EVM)
+            dup_t = dup_t | (isrem & (ee == addr))
+            dup_v = dup_v | (isrem & (ee == l_addr))
     dup = (dup_t & rem_txn) | (dup_v & rem_vic & ~rel_any)
     n_need = (rem_txn.astype(jnp.int32)
               + (rem_vic & ~rel_any_all).astype(jnp.int32)
@@ -189,6 +213,15 @@ def fold_step(cfg: SystemConfig, c, node, oa, val, live, k, horizon,
         dep_stop | over_q | over_g | dup | ~(hit | is_txn))
     stop_now = stop_now | ((~c["stopped"]) & ~live)
     act = ~c["stopped"] & ~stop_now & (hit | is_txn)
+    # stop-reason flags (anatomy; priority order mirrors stop_now)
+    was = ~c["stopped"]
+    s_live = c["s_live"] | (was & stop_now & ~live)
+    s_dep = c["s_dep"] | (was & stop_now & live & dep_stop)
+    s_overq = c["s_overq"] | (was & stop_now & live & ~dep_stop & over_q)
+    s_overg = c["s_overg"] | (was & stop_now & live & ~dep_stop
+                              & ~over_q & over_g)
+    s_dup = c["s_dup"] | (was & stop_now & live & ~dep_stop & ~over_q
+                          & ~over_g & dup)
 
     # --- truncation (replay only; pre-pass gets zero bad/ocode) ------------
     o1 = c["n_slot"]
@@ -341,10 +374,17 @@ def fold_step(cfg: SystemConfig, c, node, oa, val, live, k, horizon,
     rrf = [((fill_r & (ci == i)) & rem_txn & rd_miss)
            | (~(fill_r & (ci == i)) & x) for i, x in enumerate(c["rrf"])]
     wf = [x | (fill_r & (ci == i)) for i, x in enumerate(c["wf"])]
+    # write-hit-after-last-fill: the fold's value for this line is
+    # newer than any slot fill, so the round middle must not apply
+    # reply patches to it (set on hit writes, cleared by fills; a step
+    # is either a hit or a fill, never both)
+    lwh = [(~(fill_r & (ci == i)))
+           & (x | (wm & (ci == i))) for i, x in enumerate(c["lwh"])]
 
     frozen = c["frozen"] | (is_txn & ~c["stopped"] & ~stop_now)
     stopped = c["stopped"] | stop_now
     return dict(ca=ca, cv=cv, cs=cs, cv_src=cv_src, rrf=rrf, wf=wf,
+                lwh=lwh,
                 dms=dms, dmc=dmc, dmo=dmo, dmm=dmm, dmm_src=dmm_src,
                 touched=touched, act_acc=act_acc, mark=mark,
                 poison=poison, cv_req=cv_req, cv_req_src=cv_req_src,
@@ -352,6 +392,8 @@ def fold_step(cfg: SystemConfig, c, node, oa, val, live, k, horizon,
                 n_slot=n_slot, n_g=n_g, seen_req=seen_req,
                 n_ret=n_ret, rh=rh, wh=wh,
                 c_rd=c_rd, c_wr=c_wr, c_up=c_up, c_ev=c_ev,
+                s_overq=s_overq, s_overg=s_overg, s_dup=s_dup,
+                s_dep=s_dep, s_live=s_live,
                 kind=kind, ent=ent, sval=sval, pos=pos, comm=comm,
                 rel=rel, relv=relv, reld=reld,
                 g_owner=g_owner, g_ci=g_ci)

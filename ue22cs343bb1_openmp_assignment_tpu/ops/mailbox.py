@@ -88,16 +88,16 @@ def dequeue(cfg: SystemConfig, state) -> tuple:
     has = state.mb_count > 0
     h = state.mb_head
     safe_h = jnp.where(has, h, 0)
-    row = state.mb_pack[rows, safe_h]                  # [N, 6 + Wm]
+    row = state.mb_pack[:, rows, safe_h]               # [6 + Wm, N]
     view = MsgView(
         has_msg=has,
-        type=jnp.where(has, row[:, MB_TYPE], int(Msg.NONE)),
-        sender=row[:, MB_SENDER],
-        addr=row[:, MB_ADDR],
-        value=row[:, MB_VALUE],
-        second=row[:, MB_SECOND],
-        dirstate=row[:, MB_DIRSTATE],
-        bitvec=jax.lax.bitcast_convert_type(row[:, MB_BV0:], jnp.uint32),
+        type=jnp.where(has, row[MB_TYPE], int(Msg.NONE)),
+        sender=row[MB_SENDER],
+        addr=row[MB_ADDR],
+        value=row[MB_VALUE],
+        second=row[MB_SECOND],
+        dirstate=row[MB_DIRSTATE],
+        bitvec=jax.lax.bitcast_convert_type(row[MB_BV0:].T, jnp.uint32),
     )
     new_head = jnp.where(has, (h + 1) % cfg.queue_capacity, h)
     new_count = state.mb_count - has.astype(jnp.int32)
@@ -115,13 +115,13 @@ def candidate_prio(cfg: SystemConfig, arb_rank) -> jnp.ndarray:
 
 
 def pack_candidates(cand: Candidates) -> jnp.ndarray:
-    """[N, S, 6 + Wm] i32 payload rows, the exact layout the ring
+    """[6 + Wm, N, S] i32 payload planes, the exact layout the ring
     scatter writes (shared with the shard_map router)."""
     flat = jnp.stack([cand.type, cand.sender, cand.addr, cand.value,
-                      cand.second, cand.dirstate], axis=-1)
+                      cand.second, cand.dirstate], axis=0)
     bv = jax.lax.bitcast_convert_type(cand.bitvec, jnp.int32)
-    return jnp.concatenate([flat, bv.reshape(*flat.shape[:2], -1)],
-                           axis=-1)
+    return jnp.concatenate(
+        [flat, jnp.moveaxis(bv, -1, 0)], axis=0)
 
 
 def segment_ranks(bucket, valid):
@@ -212,12 +212,13 @@ def deliver(cfg: SystemConfig, state, cand: Candidates, arb_rank,
     tgt_r = jnp.where(accept, r_s, N)      # OOB row -> dropped by scatter
     tgt_p = jnp.where(accept, pos, 0)
 
-    # pack the candidate fields into message rows; the whole delivery is
-    # then ONE scatter of [F, 6 + Wm] rows
-    pack = pack_candidates(cand).reshape(F, -1)[order]
+    # pack the candidate fields into message planes; the whole delivery
+    # is then ONE scatter of [6 + Wm, F] fibers into the (node, slot)
+    # plane — in place (plane-major ring layout, state.SimState)
+    pack = pack_candidates(cand).reshape(-1, F)[:, order]
 
     updates = dict(
-        mb_pack=state.mb_pack.at[tgt_r, tgt_p].set(pack, mode="drop"),
+        mb_pack=state.mb_pack.at[:, tgt_r, tgt_p].set(pack, mode="drop"),
         mb_head=new_head,
         mb_count=new_count.at[tgt_r].add(
             accept.astype(jnp.int32), mode="drop"),
@@ -253,6 +254,6 @@ def push_message(cfg: SystemConfig, state, receiver: int, *, type,
                       int(second), int(dirstate)], jnp.int32),
          jax.lax.bitcast_convert_type(bv, jnp.int32)])
     return state.replace(
-        mb_pack=state.mb_pack.at[r, tail].set(row),
+        mb_pack=state.mb_pack.at[:, r, tail].set(row),
         mb_count=state.mb_count.at[r].add(1),
     )

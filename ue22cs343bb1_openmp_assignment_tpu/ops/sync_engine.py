@@ -279,8 +279,15 @@ def claim_max_rounds(cfg: SystemConfig) -> int:
     eviction notices from fill requests in the lane (ops/deep_engine),
     halving the round budget."""
     prio_bits = max(1, (cfg.num_nodes - 1).bit_length())
-    extra = 1 if cfg.deep_window else 0
-    return (1 << (30 - prio_bits - extra)) - 1
+    if cfg.deep_window:
+        # one extra lane key bit (the ev tag) plus, with absorption
+        # waves, slot-index bits (same-entry program order); the
+        # wave-stamp DM_ACT packing (round << 11) further caps the
+        # absolute round counter at 2^20
+        sb = (0 if cfg.deep_waves == 1
+              else max(1, (cfg.deep_slots - 1).bit_length()))
+        return min((1 << (30 - prio_bits - 1 - sb)) - 1, (1 << 20) - 1)
+    return (1 << (30 - prio_bits)) - 1
 
 
 def check_exact_directory(cfg: SystemConfig, st: SyncState) -> dict:
@@ -412,18 +419,18 @@ def round_step(cfg: SystemConfig, st: SyncState,
     Pallas kernels on procedural workloads (ops.pallas_burst /
     ops.pallas_window), bit-identically."""
     if cfg.deep_window:
-        # the Pallas deep round implements single-wave semantics only;
-        # deep_waves > 1 must take the XLA round or the configured wave
-        # count would silently not run (advisor finding, round 3)
-        if cfg.pallas_burst and not with_events and cfg.deep_waves == 1:
-            from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_burst
-            if pallas_burst.tileable(cfg.num_nodes):
-                from ue22cs343bb1_openmp_assignment_tpu.ops.pallas_deep \
-                    import round_step_deep_pallas
-                return round_step_deep_pallas(cfg, st)
         from ue22cs343bb1_openmp_assignment_tpu.ops.deep_engine import (
             round_step_deep)
-        return round_step_deep(cfg, st, with_events)
+        fold_impl = "xla"
+        if cfg.pallas_burst and not with_events:
+            from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_burst
+            if pallas_burst.tileable(cfg.num_nodes):
+                # the round middle (arbitration, waves, composition,
+                # fan-out) is shared; only the two W-step folds move
+                # into the kernels — so every deep feature, including
+                # absorption waves, runs under either fold backend
+                fold_impl = "pallas"
+        return round_step_deep(cfg, st, with_events, fold_impl=fold_impl)
     if cfg.pallas_burst and cfg.procedural and not with_events:
         from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_burst
         use_pallas = pallas_burst.tileable(cfg.num_nodes)

@@ -74,6 +74,11 @@ def state_shardings(cfg, mesh: Mesh, state):
     def spec(x):
         if getattr(x, "ndim", 0) >= 1 and x.shape[0] in node_major:
             return NamedSharding(mesh, P(lead, *([None] * (x.ndim - 1))))
+        if getattr(x, "ndim", 0) >= 2 and x.shape[1] == cfg.num_nodes:
+            # plane-major tensors (the mailbox ring, [P, N, Q]): the
+            # node axis is axis 1
+            return NamedSharding(
+                mesh, P(None, lead, *([None] * (x.ndim - 2))))
         return NamedSharding(mesh, P())
 
     return jax.tree.map(spec, state)

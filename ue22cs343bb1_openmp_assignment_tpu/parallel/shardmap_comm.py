@@ -45,9 +45,11 @@ from ue22cs343bb1_openmp_assignment_tpu.parallel.mesh import AXIS
 from ue22cs343bb1_openmp_assignment_tpu.types import Msg
 
 # the delivery-order/payload definitions are owned by ops.mailbox
-# (deliver calls the same two functions), re-exported here for router
-# callers
-pack_fields = pack_candidates
+# (deliver uses the same packing). The ring stores planes ([P, N, S],
+# in-place scatter layout); the router shards over the NODE axis, so
+# its payload keeps node-major [N, S, P] rows.
+def pack_fields(cand: Candidates) -> jnp.ndarray:
+    return jnp.moveaxis(pack_candidates(cand), 0, -1)
 
 
 class RoutedMsgs(NamedTuple):

@@ -92,11 +92,15 @@ class SimState(struct.PyTreeNode):
     waiting_since: jnp.ndarray # [N] i32
 
     # -- mailboxes (reference messageBuffer, assignment.c:81-87) ----------
-    # one packed ring tensor: columns MB_TYPE..MB_DIRSTATE then
+    # one packed ring tensor: planes MB_TYPE..MB_DIRSTATE then
     # cfg.msg_bitvec_words bitvector words (u32 bitcast to i32) — a
-    # message is one row, so dequeue is ONE gather and delivery ONE
-    # scatter regardless of field count
-    mb_pack: jnp.ndarray       # [N, Q, 6 + Wm] i32
+    # message is one [6 + Wm] fiber, so dequeue is ONE gather and
+    # delivery ONE scatter regardless of field count. PLANE-MAJOR
+    # layout ([P, N, Q], not [N, Q, P]): the minor dims are the large,
+    # well-tiling (node, slot) plane, so the per-cycle delivery scatter
+    # updates the ring in place instead of forcing a relayout copy of
+    # the whole tensor every cycle (PERF.md, async cycle decomposition)
+    mb_pack: jnp.ndarray       # [6 + Wm, N, Q] i32
     mb_head: jnp.ndarray       # [N] i32
     mb_count: jnp.ndarray      # [N] i32
 
@@ -196,7 +200,7 @@ def init_state(cfg: SystemConfig, traces=None, issue_delay=None,
         cur_val=jnp.zeros((N,), jnp.int32),
         waiting=jnp.zeros((N,), bool),
         waiting_since=jnp.full((N,), -1, jnp.int32),
-        mb_pack=jnp.zeros((N, Q, 6 + Wm), jnp.int32).at[:, :, MB_TYPE].set(
+        mb_pack=jnp.zeros((6 + Wm, N, Q), jnp.int32).at[MB_TYPE].set(
             int(Msg.NONE)),
         mb_head=jnp.zeros((N,), jnp.int32),
         mb_count=jnp.zeros((N,), jnp.int32),

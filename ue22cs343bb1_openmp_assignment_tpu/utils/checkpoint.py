@@ -41,7 +41,7 @@ import numpy as np
 from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
 from ue22cs343bb1_openmp_assignment_tpu.state import Metrics, SimState
 
-FORMAT_VERSION = 3  # v3: packed mailbox tensor (mb_pack); v2: + waiting_since, fault_key, injected-drop metric
+FORMAT_VERSION = 4  # v4: plane-major mailbox ring ([P, N, Q] mb_pack); v3: packed mailbox tensor; v2: + waiting_since, fault_key, injected-drop metric
 
 
 def _state_classes(kind: str):
