@@ -72,6 +72,14 @@ def main():
     ap.add_argument("--deep-slack", type=int, default=4,
                     help="deep engine: adaptive attempt-horizon slack "
                          "(4 measured best; PERF.md)")
+    ap.add_argument("--read-storm", action="store_true",
+                    help="deep engine: bulk-grant all same-round "
+                         "losing READ requests per entry (the "
+                         "many-readers lever for lu/hotspot)")
+    ap.add_argument("--no-exact-flags", action="store_true",
+                    help="deep engine: restore round-4 attempt-based "
+                         "marker/poison flags (A/B lever for the "
+                         "commit-prefix-exact flag pass)")
     ap.add_argument("--queue-capacity", type=int, default=None,
                     help="async engine: mailbox ring slots per node "
                          "(default 64; the ring tensor is copied every "
@@ -150,7 +158,9 @@ def main():
                                   deep_slots=args.deep_slots,
                                   deep_ownerval_slots=args.deep_g,
                                   deep_horizon_slack=args.deep_slack,
-                                  deep_waves=args.deep_waves)
+                                  deep_waves=args.deep_waves,
+                                  deep_read_storm=args.read_storm,
+                                  deep_exact_flags=not args.no_exact_flags)
     if args.procedural and (not sync_like
                             or args.workload != "uniform"
                             or args.replicas > 1):

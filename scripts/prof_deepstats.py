@@ -31,17 +31,34 @@ def main():
     ap.add_argument("--g", type=int, default=3)
     ap.add_argument("--slack", type=int, default=2)
     ap.add_argument("--local", type=int, default=800)
+    ap.add_argument("--waves", type=int, default=1)
+    ap.add_argument("--storm", action="store_true")
+    ap.add_argument("--no-exact-flags", action="store_true")
+    ap.add_argument("--workload", default=None,
+                    help="stored workload (models.workloads name) "
+                         "instead of the procedural uniform source")
     args = ap.parse_args()
     N = args.nodes
     cfg = SystemConfig.scale(N, drain_depth=args.dd, txn_width=args.tw)
+    proc = {} if args.workload else dict(procedural="uniform",
+                                         max_instrs=1)
     cfg = dataclasses.replace(
-        cfg, procedural="uniform", max_instrs=1,
-        proc_local_permille=args.local, deep_window=True,
+        cfg, proc_local_permille=args.local, deep_window=True,
         deep_slots=args.slots, deep_ownerval_slots=args.g,
-        deep_horizon_slack=args.slack)
+        deep_horizon_slack=args.slack, deep_waves=args.waves,
+        deep_read_storm=args.storm,
+        deep_exact_flags=not args.no_exact_flags, **proc)
     print(f"backend={jax.default_backend()} N={N} W={args.dd + args.tw} "
           f"Q={args.slots} slack={args.slack} local={args.local}")
-    st = se.procedural_state(cfg, args.len, seed=0)
+    if args.workload:
+        from ue22cs343bb1_openmp_assignment_tpu.models.system import (
+            CoherenceSystem)
+        st = se.from_sim_state(
+            cfg, CoherenceSystem.from_workload(
+                cfg, args.workload, trace_len=args.len, seed=0).state,
+            seed=0)
+    else:
+        st = se.procedural_state(cfg, args.len, seed=0)
     st = se.run_rounds(cfg, st, args.warm)
 
     step = jax.jit(lambda s: round_step_deep(cfg, s, return_stats=True))
@@ -63,7 +80,7 @@ def main():
           f"{per['abort_poison']:.3f}  mark aborts {per['abort_mark']:.3f}"
           f"  probe bad {per['probe_bad']:.3f}")
     print(f"  committed slots {per['committed']:.2f}  released "
-          f"{per['released']:.3f}")
+          f"{per['released']:.3f}  storm grants {per['storm']:.3f}")
     print(f"  frac nodes truncated {per['truncated']:.3f}  stopped "
           f"{per['stopped']:.3f}  past-first-request {per['seen_req']:.3f}")
     print(f"  clean (no post-request own touches) {per['clean']:.3f}")

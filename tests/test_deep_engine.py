@@ -76,6 +76,22 @@ def test_parameter_sweep(N, lf, dd, tw, Q, G):
     drain_checked(cfg, length=48, check_every=2)
 
 
+@pytest.mark.parametrize("lf,seed,waves", [
+    (200, 0, 1),      # heavy contention, classic single-winner rounds
+    (800, 1, 1),      # headline-like locality
+    (0, 2, 2),        # all-remote + waves-and-storm composition
+])
+def test_read_storm_invariants_and_progress(lf, seed, waves):
+    """The read-storm bulk grant (cfg.deep_read_storm) preserves the
+    exact directory at every round boundary and drains to quiescence:
+    k same-round readers compose in one k-aggregated step (S count +=
+    k, EM owners flush+downgrade, U rows grant E-to-one / S-to-many)."""
+    cfg = deep_cfg(8, lf, seed=seed)
+    cfg = dataclasses.replace(cfg, deep_waves=waves,
+                              deep_read_storm=True)
+    drain_checked(cfg, length=48)
+
+
 def test_local_only_parity_with_single_engine():
     """All-local workloads are schedule-independent: the deep engine
     must match the single-transaction engine's final state exactly."""
@@ -100,10 +116,27 @@ def test_local_only_parity_with_single_engine():
 
 def test_runner_integration_and_budget():
     """run_sync_to_quiescence dispatches deep rounds and asserts the
-    halved claim budget (the lane spends one key bit on the ev tag)."""
+    claim budget: the lane spends one key bit on the ev tag, and the
+    wave-stamp DM_ACT packing (round << 11, sync_engine.py) caps the
+    absolute round counter at 2^20 - 1 for every deep config."""
     cfg = deep_cfg(8, 700)
     nb = max(1, (cfg.num_nodes - 1).bit_length())
-    assert se.claim_max_rounds(cfg) == (1 << (30 - nb - 1)) - 1
+    assert se.claim_max_rounds(cfg) == min((1 << (30 - nb - 1)) - 1,
+                                           (1 << 20) - 1)
+    # at 8 nodes (nb=3) the 2^20 DM_ACT cap is the binding bound
+    assert se.claim_max_rounds(cfg) == (1 << 20) - 1
+    # with waves, slot-index bits shrink the lane budget further, but
+    # the DM_ACT cap still binds at small N
+    waved = dataclasses.replace(cfg, deep_waves=4)
+    sb = max(1, (cfg.deep_slots - 1).bit_length())
+    assert se.claim_max_rounds(waved) == min((1 << (30 - nb - 1 - sb)) - 1,
+                                             (1 << 20) - 1)
+    # at large N the lane-key budget binds instead of the DM_ACT cap
+    big = dataclasses.replace(deep_cfg(4096, 700), deep_waves=4)
+    nb_big = max(1, (big.num_nodes - 1).bit_length())
+    sb_big = max(1, (big.deep_slots - 1).bit_length())
+    assert se.claim_max_rounds(big) == min(
+        (1 << (30 - nb_big - 1 - sb_big)) - 1, (1 << 20) - 1)
     out = se.run_sync_to_quiescence(cfg, se.procedural_state(cfg, 32),
                                     chunk=8, max_rounds=4000)
     assert bool(out.quiescent())

@@ -181,6 +181,50 @@ WAVE_CASES = {
 }
 
 
+# Read-storm races: many READERS funnel onto one entry in a single
+# round — the shapes the bulk grant (cfg.deep_read_storm, round 5)
+# k-aggregates: pure read storms (U -> E for one reader, all-SHARED
+# for two+), a storm on a freshly written EM row (owner flushes and
+# downgrades via the dw stamp), a storm racing the home's own chain,
+# and a storm crossing an eviction notice.
+STORM_CASES = {
+    "storm_rrr": [[(0, 0x30, 0)], [(0, 0x30, 0)], [(0, 0x30, 0)], []],
+    "storm_w_rr": [[(1, 0x30, 5)], [(0, 0x30, 0)], [(0, 0x30, 0)], []],
+    "storm_rr_then_w": [[(0, 0x30, 0), (1, 0x30, 1)],
+                        [(0, 0x30, 0)], [(0, 0x30, 0)], []],
+    "storm_home_chain": [[(0, 0x30, 0)], [(0, 0x30, 0)],
+                         [(0, 0x30, 0)], [(1, 0x30, 9)]],
+    "storm_evict": [[(0, 0x31, 0), (0, 0x21, 0)], [(0, 0x31, 0)],
+                    [(0, 0x31, 0)], []],
+}
+
+
+@pytest.mark.parametrize("waves", [1, 2])
+@pytest.mark.parametrize(
+    "name", sorted(STORM_CASES) + ["wave_rrw", "migrate3"])
+def test_deep_read_storm_outcomes_are_reachable(name, waves):
+    """Deep rounds with the read-storm bulk grant must still land only
+    in the message-level machine's outcome set (the k-aggregated
+    read composition is a legal read-after-read serialization)."""
+    import dataclasses
+    traces = {**CASES, **WAVE_CASES, **STORM_CASES}[name]
+    a = async_outcomes(SystemConfig.reference(), traces, max_delay=24,
+                       delay_step=6, n_ranks=12)
+    a.update(async_outcomes(SystemConfig.reference(), traces,
+                            max_delay=6, delay_step=2, n_ranks=12))
+    cfg = dataclasses.replace(
+        SystemConfig.reference(), deep_window=True, drain_depth=3,
+        txn_width=2, deep_slots=4, deep_ownerval_slots=2,
+        deep_waves=waves, deep_read_storm=True)
+    s = sync_outcomes(cfg, traces)
+    assert len(a) >= 1 and len(s) >= 1
+    missing = {fp: seed for fp, seed in s.items() if fp not in a}
+    assert not missing, (
+        f"{name}: read-storm waves={waves} seeds "
+        f"{sorted(missing.values())} produced final states outside "
+        f"the async outcome set ({len(s)} deep / {len(a)} async)")
+
+
 @pytest.mark.parametrize("waves", [1, 3])
 @pytest.mark.parametrize(
     "name", sorted(WAVE_CASES) + ["migrate3", "upgrade_race",

@@ -99,3 +99,29 @@ def test_every_accepted_run_is_witnessed(suite, n_runs):
     assert names == {f"run_{i}" for i in range(1, n_runs + 1)}
     covered = {r for s, r in WITNESSES if s == suite}
     assert covered == names
+
+
+@requires_reference
+def test_fixture_audit_every_shipped_run_is_a_quiescent_outcome():
+    """Divergence audit (ARCHITECTURE.md decision 6): the reference's
+    sleep-then-`kill -9` harness (``test3.sh:9-12``) CAN freeze a
+    non-quiescent snapshot (dump re-armed at ``assignment.c:171-173``,
+    written at ``assignment.c:639-645`` before late traffic lands);
+    this repo's engines realize quiescent outcomes only. That design
+    rests on the empirical fact that every fixture shipped with the
+    reference is a quiescent state — proven constructively by the
+    witness tests above, which reach each one AT QUIESCENCE. This
+    audit scans the reference tree directly (independent of the
+    accepted-outcome loader), so a future fixture drop that adds a
+    kill snapshot fails here instead of silently losing parity."""
+    for suite in ("test_3", "test_4"):
+        shipped = {d for d in os.listdir(
+            os.path.join(REFERENCE_TESTS, suite))
+            if d.startswith("run_")}
+        pinned = {r for s, r in WITNESSES if s == suite}
+        assert shipped == pinned, (
+            f"{suite}: shipped runs {sorted(shipped)} != quiescent-"
+            f"witnessed runs {sorted(pinned)} — a new fixture may be "
+            "a non-quiescent kill snapshot (ARCHITECTURE.md decision "
+            "6); find a witness with scripts/search_racy.py or "
+            "document the divergence")

@@ -34,7 +34,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="where to write core_<n>_output.txt dumps")
     p.add_argument("--workload", choices=["uniform", "producer_consumer",
                                           "false_sharing", "fft", "radix",
-                                          "hotspot"],
+                                          "hotspot", "lu"],
                    help="run a synthetic workload instead of trace files "
                         "(fft/radix are SPLASH-2-style reference "
                         "patterns)")
@@ -244,7 +244,21 @@ def _main_sync(args) -> int:
                 # explicit value, and silently reshaping the round on
                 # resume was an advisor finding (round 3)
                 over["deep_slots"] = args.deep_slots
+            old_cfg = cfg
             cfg = _dc.replace(cfg, **over)
+            # changing the lane-key slot-bit width (SB) on resume would
+            # leave stale DM_CLAIM keys packed under the old layout in
+            # the checkpointed dm — stale keys could then compare below
+            # fresh ones, breaking the countdown invariant (advisor,
+            # round 4). The layout is (deep_window, slot_bits,
+            # deep_read_storm): turning deep windows on adds the ev
+            # tag bit, waves add slot bits, read storms add the is_rd
+            # bit above the priority field.
+            def _layout(c):
+                return (c.deep_window, se.slot_bits(c),
+                        c.deep_read_storm)
+            if _layout(old_cfg) != _layout(cfg) and hasattr(st, "dm"):
+                st = st.replace(dm=se.reset_claims(st.dm))
         if args.arb_seed is not None:
             st = st.replace(seed=np.int32(args.arb_seed))
     else:
@@ -479,7 +493,7 @@ def _main_omp(args) -> int:
                  "save_checkpoint", "resume", "check", "check_strict",
                  "metrics", "dump", "run_cycles", "procedural",
                  "drain_depth", "txn_width", "deep_window", "deep_slots",
-                 "queue_capacity", "sweep_seeds"):
+                 "deep_waves", "queue_capacity", "sweep_seeds"):
         v = getattr(args, flag)
         # identity checks: 0 and 0.0 compare equal to False but are
         # explicit user values and must be rejected, not dropped

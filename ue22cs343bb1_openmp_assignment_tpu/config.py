@@ -101,6 +101,27 @@ class SystemConfig:
     # fan-out encoding, ops/deep_engine). 1 = single-winner rounds.
     # Capped at 14 by the 4-bit wave-stamp fields in DM_ACT.
     deep_waves: int = 1
+    # read-storm bulk grant (round 5): after the absorption waves, ALL
+    # still-losing READ requests on an entry commit together as one
+    # final pseudo-wave — reads commute, so k same-round readers
+    # compose in one k-aggregated step (S count += k; an EM owner
+    # flushes and downgrades via the wave stamps; U rows grant E to a
+    # single reader, S to two or more — exactly the reference's
+    # read-after-read serialization, assignment.c:211-236). The
+    # granting node's window truncates after its first bulk slot (its
+    # storm read is its last committed event that round — program
+    # order). The many-readers-one-entry lever (lu's pivot rows,
+    # hotspot's read half); costs ~3 [Q, N] index ops per round, so
+    # off by default for low-contention workloads.
+    deep_read_storm: bool = False
+    # commit-prefix-exact marker/poison flags (round 5): derive the
+    # home-side conflict flags from a lane-truncated flag-pass fold
+    # instead of the full attempt horizon, eliminating the ghost
+    # aborts that pinned committed depth (PERF.md stop-reason
+    # anatomy). One extra fold pass + one extra [Q, N] gather per
+    # round; False restores the round-4 attempt-based flags (A/B
+    # lever, bench --no-exact-flags).
+    deep_exact_flags: bool = True
 
     # Procedural workload (sync engine): when set (e.g. "uniform"),
     # instructions are computed per (node, index) from a counter-based
@@ -153,6 +174,18 @@ class SystemConfig:
             raise ValueError(
                 "deep_waves must be in [1, 14] (wave stamps pack into "
                 "4-bit DM_ACT fields; see ops/deep_engine)")
+        if self.deep_read_storm and self.deep_waves > 13:
+            raise ValueError(
+                "deep_read_storm uses the stamp one past the last "
+                "wave, so deep_waves <= 13 when the storm is on "
+                "(4-bit DM_ACT stamp fields)")
+        if self.deep_read_storm and self.num_nodes > (1 << 15) - 1:
+            raise ValueError(
+                "deep_read_storm needs num_nodes <= 32767: the "
+                "per-entry evictor count packs as ke << 16 in an "
+                "int32 scatter-add (ke can reach num_nodes), and "
+                "multi-slot storm rows use requester id 0xFFFF as "
+                "the matches-nobody sentinel (ops/deep_engine)")
         if self.inv_mode not in ("mailbox", "scatter"):
             raise ValueError(f"bad inv_mode {self.inv_mode!r}")
         if self.inv_mode == "mailbox" and self.num_nodes > 64:

@@ -116,6 +116,20 @@ serialization of the reference machine):
    2-bit composed action (act_h); promotions keep a pending bit with
    promote-then-X overrides.
 
+   * **Read storms** (``cfg.deep_read_storm``): after the waves, ALL
+     still-losing READ requests commit together as one terminal
+     pseudo-wave — reads commute, so k same-round readers compose in
+     a single k-aggregated step against the post-wave row (S count +=
+     k; an EM owner flushes once and downgrades via the dw stamp; a U
+     row grants E to a lone reader, all-SHARED to two or more —
+     exactly the reference's read-after-read serialization end state,
+     ``assignment.c:211-236``). From its first losing read onward a
+     node is in the storm ZONE: every further non-aborted read joins
+     the storm point (wave wins revoked, so the node's committed
+     slots stay serialization-ordered), and any other slot kind
+     truncates the window there — nothing may serialize after the
+     storm point.
+
 Progress: a node's own-entry chains never lose arbitration, and the
 per-round reshuffled lane priority guarantees some requester wins each
 contended entry, so every trace drains (the runners assert the same
@@ -142,7 +156,7 @@ from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState, Op
 from ue22cs343bb1_openmp_assignment_tpu.ops import deep_fold
 from ue22cs343bb1_openmp_assignment_tpu.ops.sync_engine import (
     DM_ACT, DM_CLAIM, DM_COLS, DM_COUNT, DM_MEM, DM_OWNER, DM_REQ,
-    DM_STATE, SyncState, _round_key, claim_max_rounds)
+    DM_STATE, SyncState, _round_key, claim_max_rounds, slot_bits)
 
 # slot kinds (remote events): fill requests and eviction notices
 K_NONE, K_RD, K_WR, K_UP, K_EVS, K_EVM, K_PROBE = 0, 1, 2, 3, 4, 5, 6
@@ -308,35 +322,103 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     # tag that lets the chain-yield and probe rules tell notices from
     # fill requests.
     prio_bits = max(1, (N - 1).bit_length())
-    SB = 0 if cfg.deep_waves == 1 else max(1, (Q - 1).bit_length())
+    SB = slot_bits(cfg)
     rk = _round_key(cfg, st, rows)
     prio = rk & ((1 << prio_bits) - 1)
     countdown = rk >> prio_bits
-    key = ((countdown << (prio_bits + 1 + SB))
+    # read-storm key layout (cfg.deep_read_storm): one extra is_rd bit
+    # ABOVE the priority bits, so ANY non-read event beats ANY read —
+    # reads never win contested lanes and always compose at the
+    # terminal storm point instead. This is what lets eviction notices
+    # and writes through entries that straggler reads would otherwise
+    # camp on (lu's old-pivot entries), and it makes "the lane minimum
+    # is a notice" imply "no fill commits on this entry this round"
+    # (the notice-storm soundness gate). Costs one countdown bit
+    # (claim_max_rounds accounts for it).
+    ST = 1 if cfg.deep_read_storm else 0
+    key = ((countdown << (prio_bits + 1 + SB + ST))
            | (prio << (1 + SB)))                             # fill key
     key_q = key[None, :]
     if SB:
         key_q = key_q | (jnp.arange(Q, dtype=jnp.int32)[:, None] << 1)
     key_q = jnp.where(is_ev, key_q | 1,
                       jnp.broadcast_to(key_q, (Q, N)))       # [Q, N]
+    if ST:
+        key_q = jnp.where(kind == K_RD,
+                          key_q | (1 << (prio_bits + 1 + SB)), key_q)
     lane_idx = jnp.where(is_req | is_ev, ent, E).reshape(-1)
     dm_claimed = st.dm.at[lane_idx, DM_CLAIM].min(
         key_q.reshape(-1), mode="drop")
 
-    # ---- gathers: lane-back + dense home flags (ONE fused gather) --------
     safe_ent = jnp.clip(ent, 0, E - 1)
-    flags_arr = (pre["mark"].astype(jnp.int32) * F_MARK
-                 + pre["poison"].astype(jnp.int32)
+    # fresh lane keys this round sit strictly below every stale key (the
+    # DM_CLAIM countdown invariant, ops/sync_engine)
+    thresh = (jnp.maximum(claim_max_rounds(cfg) - st.round, 0) + 1) \
+        << (prio_bits + 1 + SB + ST)
+    pmask = (1 << prio_bits) - 1
+    prio_self = prio[None, :]                                # [1, N]
+    # chain-yield codes (dense own-slice reads — own entries are never
+    # our own lane targets, so any fresh key there is foreign). The
+    # yield rules themselves run inside the replay fold
+    # (deep_fold.fold_step, the y_bad section): a chain TXN touch
+    # yields to a winning fresh notice at any position and to a winning
+    # fresh fill request after our first request attempt; post-request
+    # own HITS yield to fresh fill requests. Flag-free (lane keys
+    # only), so the flag-pass fold below can consume it too.
+    own_lane = dm_claimed.reshape(N, S, DM_COLS)[:, :, DM_CLAIM].T
+    o_fresh = own_lane < thresh                              # [S, N]
+    o_ev = (own_lane & 1) == 1
+    o_beats = ((own_lane >> (1 + SB)) & pmask) < prio[None, :]  # sender wins
+    # per-entry code bits, deep_fold.OC_*: 1 = fresh, 2 = fresh EV,
+    # 4 = fresh & sender beats the home's priority
+    o_code = (o_fresh.astype(jnp.int32) * deep_fold.OC_FRESH
+              | (o_fresh & o_ev).astype(jnp.int32) * deep_fold.OC_EV
+              | (o_fresh & o_beats).astype(jnp.int32)
+              * deep_fold.OC_BEATS)                          # [S, N]
+
+    # ---- flag-pass fold: commit-prefix-sharp marker/poison (round 5) ----
+    # The round-4 flags were attempt-based over the full horizon W; at
+    # committed depth ~4.6 vs horizon ~13, ~2/3 of poison flags were
+    # GHOSTS from attempts beyond the committed prefix, and the
+    # resulting aborts pinned depth (the ghost-abort feedback loop,
+    # PERF.md). Here a third fold pass re-runs the window truncated by
+    # the DENSE flag-free verdicts only — in-fold stops and chain
+    # yields (o_code) — and its retirement-gated mark/poison outputs
+    # flag only the touches inside that prefix. Soundness: the flag
+    # pass's truncation set is pointwise a SUBSET of the final
+    # replay's (the final adds slot verdicts — lane losses and
+    # flag-based aborts — on top), so the flag-pass prefix is a
+    # SUPERSET of the final committed prefix, and the sharper flags
+    # still over-approximate every committed touch — the same
+    # conservativity contract as the round-4 flags, minus the ghosts
+    # beyond yield/stop points. No circularity: o_code depends only on
+    # the lane scatter, never on other homes' flags. Using ONLY dense
+    # verdicts (no per-slot bad) keeps the flag gather fusable with
+    # the lane gather below — the whole pass costs one extra fold and
+    # zero extra index ops (measured: the slot-verdict variant's extra
+    # [Q, N] gather cost more than its sharper flags bought back).
+    if cfg.deep_exact_flags:
+        if fold_impl == "pallas":
+            from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_deep
+            fpass = pallas_deep.fold_flags(cfg, st, tiles, w_oa,
+                                           w_val, w_live, o_code)
+        else:
+            fpass = _fold_deep(cfg, st, tiles, w_oa, w_val, w_live,
+                               bad=None, ocode=o_code)
+        flag_mark, flag_poison = fpass["mark"], fpass["poison"]
+    else:
+        flag_mark, flag_poison = pre["mark"], pre["poison"]
+    poison_src = flag_poison
+
+    # ---- gathers: lane-back + dense home flags (ONE fused gather) --------
+    flags_arr = (flag_mark.astype(jnp.int32) * F_MARK
+                 + flag_poison.astype(jnp.int32)
                  * F_POISON).T.reshape(E)
     side = jnp.stack([dm_claimed[:, DM_CLAIM], flags_arr], axis=-1)
     got2 = side[safe_ent]                                    # [Q, N, 2]
     lane_got, got_flags = got2[..., 0], got2[..., 1]
 
     # ---- truncation ------------------------------------------------------
-    # fresh lane keys this round sit strictly below every stale key (the
-    # DM_CLAIM countdown invariant, ops/sync_engine)
-    thresh = (jnp.maximum(claim_max_rounds(cfg) - st.round, 0) + 1) \
-        << (prio_bits + 1 + SB)
     lane_fresh = lane_got < thresh
     lane_is_ev = (lane_got & 1) == 1
     won = lane_got == key_q
@@ -345,11 +427,9 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     # global-minimum-priority node never yields, aborts, or loses — so
     # every round someone (in practice almost everyone) advances. The
     # per-node priority is a pure bijection of the node id, so the
-    # home's priority needs no gather. Marks/poison are attempt-based
-    # (conservative): aborting on a ghost touch costs a retry, never
-    # soundness.
-    pmask = (1 << prio_bits) - 1
-    prio_self = prio[None, :]                                # [1, N]
+    # home's priority needs no gather. Marks/poison over-approximate
+    # committed touches (conservative): aborting on a ghost touch
+    # costs a retry, never soundness.
     prio_home = _round_key(cfg, st, safe_ent >> cfg.block_bits) & pmask
     home_wins = prio_home < prio_self                        # [Q, N]
     # the clean-requester relaxation (round 4): the poison rule exists
@@ -358,10 +438,11 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     # attempt (the cycle's incoming edge composes on that touch). A
     # node with NO such attempted touch — "clean" — cannot be inside
     # any cycle, so its requests may compose on poisoned rows even when
-    # the home's priority wins. Computed from the pre-pass poison
-    # flags, which over-approximate the committed touches (replay is a
-    # prefix of the pre-pass), so clean is sound, not just heuristic.
-    clean_self = ~jnp.any(pre["poison"], axis=0)             # [N]
+    # the home's priority wins. Computed from flags that
+    # over-approximate the committed touches (the final replay prefix
+    # is contained in both the pre-pass and the flag pass), so clean
+    # is sound, not just heuristic.
+    clean_self = ~jnp.any(poison_src, axis=0)                # [N]
     req_abort = (is_req & ((got_flags & F_POISON) != 0) & home_wins
                  & ~clean_self[None, :])
     aborting = (req_abort
@@ -399,9 +480,66 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
         won_j = cand & (lane_j[safe_ent] == key_q)
         won_list.append(won_j)
         won_any = won_any | won_j
-    req_bad = is_req & (~won_any | req_abort)
-    ev_bad = is_ev & (~won | (((got_flags & F_MARK) != 0)
-                              & home_wins))
+    # ---- read-storm bulk grant (cfg.deep_read_storm) ---------------------
+    # After the waves, ALL still-losing READ requests commit together
+    # as one final pseudo-wave: reads commute, so any number of
+    # same-round readers compose in a single k-aggregated step against
+    # the post-wave row (the many-readers-one-entry serialization the
+    # per-entry claim lane otherwise spreads over k rounds — lu's
+    # pivot rows, hotspot's read half; assignment.c:211-236 is the
+    # message-level original being batched). Soundness: a storm slot
+    # is exactly a wave candidate (same poison/abort gating, same
+    # chain-yield lane-minimum argument), serialized after every wave;
+    # since a storm node may have lost arbitration elsewhere, its
+    # window TRUNCATES after its first storm slot (every later slot is
+    # marked bad), which keeps the committed stream a program-order
+    # prefix and keeps cross-entry serialization acyclic.
+    ev_abort = is_ev & ((got_flags & F_MARK) != 0) & home_wins
+    if cfg.deep_read_storm:
+        # storm ZONE: from the node's first losing (non-aborted) read
+        # or EVICT_SHARED notice onward. Inside the zone every further
+        # read and EVS notice — lane winners included, their wave wins
+        # revoked below — joins the storm point; any OTHER slot kind
+        # (write, upgrade, EVICT_MODIFIED, probe) truncates the window
+        # there: nothing non-commutative may serialize after the storm
+        # point. Reads add sharers, EVS notices remove them — both
+        # commute per entry up to the promotion/uncached endpoints,
+        # which the k-aggregated composition in the commit loop
+        # resolves in a fixed readers-first order (any fixed order of
+        # individually-legal granted ops is a legal serialization).
+        # With the is_rd key bit, EVERY read composes at the storm
+        # point (a read can top a lane only when nothing non-read
+        # claimed it, and such wins are revoked below), so the zone
+        # opens at the node's FIRST read. An eviction notice may NOT
+        # serialize after a same-round KILL-like event on its entry
+        # (the evictor's line would have died before it could evict —
+        # no legal order). Reads never invalidate, so read storms
+        # compose over anything; notice storms are gated on "no fill
+        # winner exists on this entry": the is_rd bit makes that
+        # exactly "the lane minimum is itself a notice" at a single
+        # wave (reads rank below notices, and losing writes/upgrades
+        # retry), and with waves > 1 extra fill winners are possible,
+        # so notice storms are off there.
+        evs_ok = (lane_is_ev if cfg.deep_waves == 1
+                  else jnp.zeros((Q, N), bool))
+        opener = ((kind == K_RD)
+                  | ((kind == K_EVS) & ~ev_abort & evs_ok & ~won))
+        zone = jnp.cumsum(opener.astype(jnp.int32), axis=0) >= 1
+        # releases are disabled in storm mode (deep_fold.fold_step),
+        # so every non-aborted read is storm-eligible — the progress
+        # guarantee needs this: under the is_rd bit a read can never
+        # win a contested lane, so storming must be unconditional
+        storm_slot = ((((kind == K_RD) & ~req_abort)
+                       | ((kind == K_EVS) & ~ev_abort & evs_ok))
+                      & zone)                                 # [Q, N]
+        zone_bad = zone & ~storm_slot
+        req_bad = is_req & ((~won_any & ~storm_slot) | req_abort)
+        ev_bad = is_ev & ((~won & ~storm_slot) | ev_abort)
+    else:
+        storm_slot = jnp.zeros((Q, N), bool)
+        zone_bad = jnp.zeros((Q, N), bool)
+        req_bad = is_req & (~won_any | req_abort)
+        ev_bad = is_ev & (~won | ev_abort)
     # probes: a fresh marker (the entry's home chain-transacted on it)
     # is always unsafe; a fresh foreign FILL request is unsafe only for
     # hits after the node's own first fill request (pre-request hits
@@ -409,24 +547,8 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     # eviction notices never endanger a hit
     probe_bad = is_probe & (((got_flags & F_MARK) != 0)
                             | ((sval != 0) & lane_fresh & ~lane_is_ev))
-    bad = (req_bad | ev_bad | probe_bad).astype(jnp.int32)   # [Q, N]
-    # chain-yield codes (dense own-slice reads — own entries are never
-    # our own lane targets, so any fresh key there is foreign). The
-    # yield rules themselves run inside the replay fold
-    # (deep_fold.fold_step, the y_bad section): a chain TXN touch
-    # yields to a winning fresh notice at any position and to a winning
-    # fresh fill request after our first request attempt; post-request
-    # own HITS yield to fresh fill requests.
-    own_lane = dm_claimed.reshape(N, S, DM_COLS)[:, :, DM_CLAIM].T
-    o_fresh = own_lane < thresh                              # [S, N]
-    o_ev = (own_lane & 1) == 1
-    o_beats = ((own_lane >> (1 + SB)) & pmask) < prio[None, :]  # sender wins
-    # per-entry code bits, deep_fold.OC_*: 1 = fresh, 2 = fresh EV,
-    # 4 = fresh & sender beats the home's priority
-    o_code = (o_fresh.astype(jnp.int32) * deep_fold.OC_FRESH
-              | (o_fresh & o_ev).astype(jnp.int32) * deep_fold.OC_EV
-              | (o_fresh & o_beats).astype(jnp.int32)
-              * deep_fold.OC_BEATS)                          # [S, N]
+    bad = (req_bad | ev_bad | probe_bad
+           | zone_bad).astype(jnp.int32)                     # [Q, N]
 
     # ---- replay fold (committed prefix) ----------------------------------
     # the fold truncates retirement at the first bad slot or
@@ -500,11 +622,38 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     fille_acc = jnp.zeros((Q, N), bool)
     fillv_acc = jnp.zeros((Q, N), jnp.int32)
     aw_acc = jnp.zeros((Q, N), jnp.int32)   # per-slot acquisition stamp
-    for j, won_j in enumerate(won_list):
-        stamp = j + 2                       # chain = 1, wave j = j + 2
-        commit = (is_req | is_ev) & won_j & rp["comm"]
+    # wave winners inside the storm zone are REVOKED (& ~storm_slot):
+    # they re-commit at the storm point instead, so a node's committed
+    # slots stay serialization-ordered (waves in slot order, then one
+    # terminal storm point for all its zone reads)
+    passes = [((is_req | is_ev) & won_j & ~storm_slot, j + 2, False)
+              for j, won_j in enumerate(won_list)]
+    if cfg.deep_read_storm:
+        # the storm pseudo-wave: stamp one past the last wave,
+        # k-aggregated composition below
+        passes.append((storm_slot, len(won_list) + 2, True))
+    storm_committed = jnp.zeros((Q, N), bool)
+    for mask_j, stamp, is_storm in passes:
+        commit = mask_j & rp["comm"]
         commit_acc = commit_acc | commit
-        g_rows = dm[safe_ent]                                # [Q, N, cols]
+        if is_storm:
+            storm_committed = commit
+            # aggregated per-entry reader/evictor counts (committed
+            # storm slots only), packed into ONE scatter-add and fused
+            # into the row gather as an extra column
+            packed = ((commit & (kind == K_EVS)).astype(jnp.int32)
+                      << 16) | (commit & (kind == K_RD)).astype(
+                          jnp.int32)
+            cnt_storm = jnp.zeros((E,), jnp.int32).at[
+                jnp.where(commit, safe_ent, E).reshape(-1)].add(
+                packed.reshape(-1), mode="drop")
+            g_rows8 = jnp.concatenate(
+                [dm, cnt_storm[:, None]], axis=-1)[safe_ent]
+            g_rows = g_rows8[..., :DM_COLS]                  # [Q, N, cols]
+            kr = g_rows8[..., DM_COLS] & 0xFFFF              # [Q, N]
+            ke = g_rows8[..., DM_COLS] >> 16
+        else:
+            g_rows = dm[safe_ent]                            # [Q, N, cols]
         r_state = g_rows[..., DM_STATE]
         r_cnt = g_rows[..., DM_COUNT]
         r_own = g_rows[..., DM_OWNER]
@@ -538,96 +687,159 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
         k_evs = commit & (kind == K_EVS)
         k_evm = commit & (kind == K_EVM)
         wlike = k_wr | k_up
-        # release: the requester displaced its own window fill of this
-        # entry later in the window (replay-gated, so only committed
-        # displacements count); the slot commits the fill+evict NET row
-        rel = rp["rel"] & (k_rd | wlike)
-        rel_acc = rel_acc | rel
-        relv = rp["relv"]
-        # new row from composition. An EVICT_SHARED from an E-line
-        # holder finds the row EM{evictor} (exactness) and leaves it
-        # Uncached — the reference's clear-bit -> 0 sharers path
-        # (assignment.c:560-570)
-        evs_cnt = jnp.where(r_s, r_cnt - 1, r_cnt)
-        n_state = jnp.where(wlike, D_EM,
-                   jnp.where(k_rd, jnp.where(r_u, D_EM, D_S),
-                    jnp.where(k_evm | (k_evs & r_em), D_U,
-                     jnp.where(k_evs & r_s,
-                               jnp.where(evs_cnt == 0, D_U,
-                                         jnp.where(evs_cnt == 1, D_EM,
-                                                   D_S)),
-                               r_state))))
-        n_cnt = jnp.where(wlike | (k_rd & r_u), 1,
-                 jnp.where(k_rd & r_em, 2,
-                  jnp.where(k_rd & r_s, r_cnt + 1,
-                   jnp.where(k_evm | (k_evs & r_em), 0,
-                    jnp.where(k_evs & r_s, evs_cnt, r_cnt)))))
-        n_own = jnp.where(wlike | (k_rd & r_u), req_id,
-                 jnp.where(k_evs & r_s & (evs_cnt == 1), -1, r_own))
-        n_mem = jnp.where((k_rd | k_wr) & r_em, own_val,
-                          jnp.where(k_evm, sval, r_mem))
-        # release net-row overrides: a released read leaves the row as
-        # it was (EM keeps its owner, memory takes the owner's flushed
-        # value); a released write nets Uncached with our final value
-        n_state = jnp.where(rel, jnp.where(wlike, D_U,
-                                           jnp.where(r_em, D_EM,
-                                                     r_state)),
-                            n_state)
-        n_cnt = jnp.where(rel, jnp.where(wlike, 0,
-                                         jnp.where(r_em, 1, r_cnt)),
-                          n_cnt)
-        n_own = jnp.where(rel, r_own, n_own)
-        n_mem = jnp.where(rel, jnp.where(wlike, relv,
-                                         jnp.where(r_em, own_val,
-                                                   r_mem)),
-                          n_mem)
-        # ---- wave-stamp act composition (see dense-merge comment) -------
         prev_ah = jnp.where(prev_fresh, (r_act >> 9) & 3, ACT_NONE)
         prev_promo = prev_fresh & (((r_act >> 8) & 1) == 1)
         prev_kw = jnp.where(prev_fresh, (r_act >> 4) & 15, 0)
         prev_dw = jnp.where(prev_fresh, r_act & 15, 0)
         tgt_home = r_own == (safe_ent >> cfg.block_bits)
-        plain_rd = k_rd & ~rel
-        # the home's own line keeps an exact 2-bit composed action
-        # (unique line, so promote-then-X composition stays explicit)
-        my_h = jnp.where(wlike, ACT_KILL,
-                jnp.where(k_rd & r_em & tgt_home,
-                          jnp.where(rel, ACT_PROMOTE, ACT_DOWN),
-                 jnp.where(k_evs & r_s & (evs_cnt == 1), ACT_PROMOTE,
-                           ACT_NONE)))
-
-        def _compose(prev, mine):
-            return jnp.where(prev == ACT_PROMOTE,
-                             jnp.where(wlike, ACT_KILL,
-                                       jnp.where(k_rd & rel, ACT_PROMOTE,
-                                                 jnp.where(k_rd, ACT_DOWN,
-                                                           ACT_NONE))),
-                             jnp.maximum(prev, mine))
-        act_h = _compose(prev_ah, my_h)
-        # all other holders resolve against wave stamps: a committed
-        # write kills every line acquired before it (aw < kw); a plain
-        # read of an EM row downgrades every earlier acquirer
-        # (aw < dw) — exactly the current owner plus already-dead
-        # lines; promote persists until a later event overrides it
-        # (promote-then-read nets a downgrade of the unnamed promotee,
-        # promote-then-write kills it, a notice cancels it)
-        n_kw = jnp.where(wlike, stamp, prev_kw)
-        n_dw = jnp.where(plain_rd & r_em & ~tgt_home, stamp, prev_dw)
-        promo_set = ((k_evs & r_s & (evs_cnt == 1))
-                     | (k_rd & rel & r_em & ~tgt_home))
-        promo_clr = wlike | k_evs | k_evm | (plain_rd & r_em)
-        n_promo = jnp.where(promo_set, True,
-                            jnp.where(promo_clr, False, prev_promo))
-        n_act = (rtag | (act_h << 9)
-                 | (n_promo.astype(jnp.int32) << 8)
-                 | (n_kw << 4) | n_dw)
-        rv_new = jnp.where(wlike & ~rel, 0x100 | (sval & 0xFF),
-                  jnp.where((k_rd & r_u & ~rel)
-                            | (k_rd & rel & r_em), 0x200, 0))
+        if is_storm:
+            # ---- k-aggregated storm composition -------------------------
+            # Every committed storm slot on an entry writes the SAME
+            # composed row (duplicate scatters must be bit-identical),
+            # derived from the aggregate (kr readers, ke evictors)
+            # against the post-wave row, serialized READERS-FIRST: any
+            # fixed order of the individually-granted commuting ops is
+            # a legal serialization, and readers-first keeps the
+            # single-reader-on-U exclusive grant (which can only arise
+            # with ke == 0, i.e. a true solo slot that may name
+            # itself). Evictors must be current holders, so ke <= held
+            # and U rows have ke == 0.
+            held = jnp.where(r_u, 0, jnp.where(r_em, 1, r_cnt))
+            c2 = held + kr - ke
+            solo_u = r_u & (kr == 1) & (ke == 0)
+            # an EM owner flushes once to serve the readers; pending
+            # rows serve memory (own_val handles both)
+            flush = r_em & ~r_pend & (kr >= 1)
+            n_state = jnp.where(c2 == 0, D_U,
+                                jnp.where(c2 >= 2, D_S, D_EM))
+            n_cnt = c2
+            promo_end = (c2 == 1) & (ke >= 1)
+            n_own = jnp.where(solo_u, req_id,
+                              jnp.where(promo_end, -1, r_own))
+            n_mem = jnp.where(flush, own_val, r_mem)
+            rel = jnp.zeros((Q, N), bool)   # pre-released slots excluded
+            # home-line action: a flushed owner that is the home's own
+            # line downgrades; the promotion endpoint promotes (the
+            # home's line is the survivor iff it holds the tag); a
+            # pending PROMOTE from an earlier wave downgraded by storm
+            # readers nets DOWN; earlier KILL/DOWN persist by max
+            my_h = jnp.where(flush & tgt_home, ACT_DOWN,
+                             jnp.where(promo_end, ACT_PROMOTE,
+                                       ACT_NONE))
+            act_h = jnp.where(prev_ah == ACT_PROMOTE,
+                              jnp.where(kr >= 1, ACT_DOWN,
+                                        jnp.where(c2 == 0, ACT_NONE,
+                                                  prev_ah)),
+                              jnp.maximum(prev_ah, my_h))
+            n_kw = prev_kw
+            n_dw = jnp.where(flush, stamp, prev_dw)
+            n_promo = jnp.where(commit, promo_end, prev_promo)
+            n_act = (rtag | (act_h << 9)
+                     | (n_promo.astype(jnp.int32) << 8)
+                     | (n_kw << 4) | n_dw)
+            # rv is consumed only by later passes; the storm is last
+            rv_new = jnp.zeros((Q, N), jnp.int32)
+        else:
+            # release: the requester displaced its own window fill of
+            # this entry later in the window (replay-gated, so only
+            # committed displacements count); the slot commits the
+            # fill+evict NET row
+            rel = rp["rel"] & (k_rd | wlike)
+            relv = rp["relv"]
+            # new row from composition. An EVICT_SHARED from an E-line
+            # holder finds the row EM{evictor} (exactness) and leaves
+            # it Uncached — the reference's clear-bit -> 0 sharers
+            # path (assignment.c:560-570)
+            evs_cnt = jnp.where(r_s, r_cnt - 1, r_cnt)
+            n_state = jnp.where(wlike, D_EM,
+                       jnp.where(k_rd, jnp.where(r_u, D_EM, D_S),
+                        jnp.where(k_evm | (k_evs & r_em), D_U,
+                         jnp.where(k_evs & r_s,
+                                   jnp.where(evs_cnt == 0, D_U,
+                                             jnp.where(evs_cnt == 1,
+                                                       D_EM, D_S)),
+                                   r_state))))
+            n_cnt = jnp.where(wlike | (k_rd & r_u), 1,
+                     jnp.where(k_rd & r_em, 2,
+                      jnp.where(k_rd & r_s, r_cnt + 1,
+                       jnp.where(k_evm | (k_evs & r_em), 0,
+                        jnp.where(k_evs & r_s, evs_cnt, r_cnt)))))
+            n_own = jnp.where(wlike | (k_rd & r_u), req_id,
+                     jnp.where(k_evs & r_s & (evs_cnt == 1), -1, r_own))
+            n_mem = jnp.where((k_rd | k_wr) & r_em, own_val,
+                              jnp.where(k_evm, sval, r_mem))
+            # release net-row overrides: a released read leaves the row
+            # as it was (EM keeps its owner, memory takes the owner's
+            # flushed value); a released write nets Uncached with our
+            # final value
+            n_state = jnp.where(rel, jnp.where(wlike, D_U,
+                                               jnp.where(r_em, D_EM,
+                                                         r_state)),
+                                n_state)
+            n_cnt = jnp.where(rel, jnp.where(wlike, 0,
+                                             jnp.where(r_em, 1, r_cnt)),
+                              n_cnt)
+            n_own = jnp.where(rel, r_own, n_own)
+            n_mem = jnp.where(rel, jnp.where(wlike, relv,
+                                             jnp.where(r_em, own_val,
+                                                       r_mem)),
+                              n_mem)
+            # ---- wave-stamp act composition (dense-merge comment) -------
+            plain_rd = k_rd & ~rel
+            # the home's own line keeps an exact 2-bit composed action
+            # (unique line, so promote-then-X composition is explicit)
+            my_h = jnp.where(wlike, ACT_KILL,
+                    jnp.where(k_rd & r_em & tgt_home,
+                              jnp.where(rel, ACT_PROMOTE, ACT_DOWN),
+                     jnp.where(k_evs & r_s & (evs_cnt == 1),
+                               ACT_PROMOTE, ACT_NONE)))
+            act_h = jnp.where(
+                prev_ah == ACT_PROMOTE,
+                jnp.where(wlike, ACT_KILL,
+                          jnp.where(k_rd & rel, ACT_PROMOTE,
+                                    jnp.where(k_rd, ACT_DOWN,
+                                              ACT_NONE))),
+                jnp.maximum(prev_ah, my_h))
+            # all other holders resolve against wave stamps: a
+            # committed write kills every line acquired before it
+            # (aw < kw); a plain read of an EM row downgrades every
+            # earlier acquirer (aw < dw) — exactly the current owner
+            # plus already-dead lines; promote persists until a later
+            # event overrides it (promote-then-read nets a downgrade
+            # of the unnamed promotee, promote-then-write kills it, a
+            # notice cancels it)
+            n_kw = jnp.where(wlike, stamp, prev_kw)
+            n_dw = jnp.where(plain_rd & r_em & ~tgt_home, stamp,
+                             prev_dw)
+            promo_set = ((k_evs & r_s & (evs_cnt == 1))
+                         | (k_rd & rel & r_em & ~tgt_home))
+            promo_clr = wlike | k_evs | k_evm | (plain_rd & r_em)
+            n_promo = jnp.where(promo_set, True,
+                                jnp.where(promo_clr, False, prev_promo))
+            n_act = (rtag | (act_h << 9)
+                     | (n_promo.astype(jnp.int32) << 8)
+                     | (n_kw << 4) | n_dw)
+            rv_new = jnp.where(wlike & ~rel, 0x100 | (sval & 0xFF),
+                      jnp.where((k_rd & r_u & ~rel)
+                                | (k_rd & rel & r_em), 0x200, 0))
+        rel_acc = rel_acc | rel
         t_idx = jnp.where(commit, safe_ent, E).reshape(-1)
+        # multi-slot storm commits write a canonical requester id and
+        # the entry's lane key so duplicate scatter rows stay
+        # bit-identical. The id sentinel is 0xFFFF: the promo fan-out's
+        # not_self test must exclude NO real holder (any tag-matching
+        # valid line is a legitimate survivor of a storm promotion);
+        # config caps storm runs at num_nodes <= 65535 so the sentinel
+        # matches nobody.
+        if is_storm:
+            multi = (kr + ke) >= 2
+            req_col = jnp.where(multi, 0xFFFF, req_id)
+            key_col = jnp.where(multi, g_rows[..., DM_CLAIM], key_q)
+        else:
+            req_col, key_col = req_id, key_q
         t_rows = jnp.stack(
             [n_state, n_cnt, n_own, n_mem, n_act,
-             req_id | (rv_new << 16), key_q],
+             req_col | (rv_new << 16), key_col],
             axis=-1).reshape(-1, DM_COLS)
         dm = dm.at[t_idx].set(t_rows, mode="drop")
 
@@ -638,7 +850,7 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
         # the same cache index in different waves, and the later
         # window slot must land last. aw_acc records each committed
         # fill slot's acquisition stamp for the fan-out.
-        fill_e = k_rd & r_u
+        fill_e = k_rd & r_u & (solo_u if is_storm else True)
         fill_val = jnp.where(wlike, sval,
                              jnp.where(r_em, own_val, r_mem))
         # write-like slots patch their own written value too (equal to
@@ -719,7 +931,8 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
         cntr["rd_miss"],
         cntr["wr_miss"],
         cntr["upg"],
-        jnp.sum((is_req | is_ev) & ~won_any, axis=0, dtype=jnp.int32),
+        jnp.sum((is_req | is_ev) & ~won_any & ~storm_committed, axis=0,
+                dtype=jnp.int32),
         cntr["ev"],
         jnp.sum(kill, axis=0, dtype=jnp.int32),
         jnp.sum(promo, axis=0, dtype=jnp.int32),
@@ -754,12 +967,13 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
             att_rd=s_(kind == K_RD), att_wr=s_(kind == K_WR),
             att_up=s_(kind == K_UP), att_evs=s_(kind == K_EVS),
             att_evm=s_(kind == K_EVM), att_probe=s_(kind == K_PROBE),
-            lost=s_((is_req | is_ev) & ~won_any & ~aborting),
+            lost=s_((is_req | is_ev) & ~won_any & ~aborting
+                    & ~storm_committed),
             abort_poison=s_(aborting & is_req),
             abort_mark=s_(aborting & is_ev),
             probe_bad=s_(probe_bad),
             committed=s_(commit_acc), released=s_(rel_acc),
-            clean=s_(clean_self),
+            clean=s_(clean_self), storm=s_(storm_committed),
             stop_overq=s_(rp["s_overq"]), stop_overg=s_(rp["s_overg"]),
             stop_dup=s_(rp["s_dup"]), stop_dep=s_(rp["s_dep"]),
             stop_live=s_(rp["s_live"]))

@@ -179,8 +179,21 @@ def fold_step(cfg: SystemConfig, c, node, oa, val, live, k, horizon,
     v_act = _sel(c["act_acc"], v_block)
 
     # --- stop conditions ---------------------------------------------------
-    rel_hit = [((kk >= K_RD) & (kk <= K_UP)) & (ee == l_addr)
-               for kk, ee in zip(c["kind"], c["ent"])]
+    if cfg.deep_read_storm:
+        # storm mode forfeits release netting: a released read would
+        # commit a different (net) row than its co-readers at the
+        # storm point, breaking the identical-duplicate-scatter
+        # property — and a never-releasable read that can also never
+        # win a lane (reads rank below all non-read claims under the
+        # is_rd key bit) would starve forever. With releases off, the
+        # displacement of an own-window fill hits the dup stop
+        # (waves == 1) or the storm-zone truncation instead, and
+        # EVERY non-aborted read is storm-eligible. Config-static, so
+        # pre/flag/replay folds keep identical slot layouts.
+        rel_hit = [jnp.zeros_like(live) for _ in c["kind"]]
+    else:
+        rel_hit = [((kk >= K_RD) & (kk <= K_UP)) & (ee == l_addr)
+                   for kk, ee in zip(c["kind"], c["ent"])]
     rel_any_all = rel_hit[0]
     for rh_ in rel_hit[1:]:
         rel_any_all = rel_any_all | rh_

@@ -133,6 +133,42 @@ def _replay_kernel(cfg, T, ca_ref, cv_ref, cs_ref, dms_ref, dmc_ref,
                              fin["c_ev"]])
 
 
+def _flags_kernel(cfg, T, ca_ref, cv_ref, cs_ref, dms_ref, dmc_ref,
+                  dmo_ref, dmm_ref, woa_ref, wval_ref, wlive_ref,
+                  hor_ref, ocode_ref, flag_ref):
+    """Flag-pass fold (round 5): the yield/stop-truncated fold whose
+    ONLY output is the retirement-gated mark/poison matrix — the
+    commit-prefix-sharp flags the round middle gathers (deep_engine,
+    the ghost-abort elimination). Slot verdicts are always zero in
+    this pass (the dense o_code yields are the only truncation), so
+    there is no bad input."""
+    fin = _run_fold(cfg, T, ca_ref, cv_ref, cs_ref, dms_ref, dmc_ref,
+                    dmo_ref, dmm_ref, woa_ref, wval_ref, wlive_ref,
+                    hor_ref, None, ocode_ref)
+    flag_ref[...] = _cat(
+        [m.astype(jnp.int32) * F_MARK + p.astype(jnp.int32) * F_POISON
+         for m, p in zip(fin["mark"], fin["poison"])])
+
+
+def _call_flags(cfg, ca_t, cv_t, cs_t, dm_t4, win_t3, hor2, ocode_t):
+    C, S = cfg.cache_size, 1 << cfg.block_bits
+    N = cfg.num_nodes
+    W = cfg.drain_depth + cfg.txn_width
+    T = _tile(N)
+    vec = pl.BlockSpec((1, T), lambda i: (0, i))
+    matC = pl.BlockSpec((C, T), lambda i: (0, i))
+    matS = pl.BlockSpec((S, T), lambda i: (0, i))
+    matW = pl.BlockSpec((W, T), lambda i: (0, i))
+    return pl.pallas_call(
+        functools.partial(_flags_kernel, cfg, T),
+        grid=(N // T,),
+        in_specs=[matC] * 3 + [matS] * 4 + [matW] * 3 + [vec, matS],
+        out_specs=pl.BlockSpec((S, T), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((S, N), jnp.int32),
+        interpret=_interpret(),
+    )(ca_t, cv_t, cs_t, *dm_t4, *win_t3, hor2, ocode_t)
+
+
 def _call_pre(cfg, ca_t, cv_t, cs_t, dm_t4, win_t3, hor2):
     C, S = cfg.cache_size, 1 << cfg.block_bits
     Q, N = cfg.deep_slots, cfg.num_nodes
@@ -196,6 +232,18 @@ def fold_pre(cfg: SystemConfig, st: SyncState, tiles, w_oa, w_val,
     return dict(kind=slotmat[:Q], ent=slotmat[Q:2 * Q],
                 sval=slotmat[2 * Q:],
                 mark=(flag_t & F_MARK) != 0,
+                poison=(flag_t & F_POISON) != 0)
+
+
+def fold_flags(cfg: SystemConfig, st: SyncState, tiles, w_oa, w_val,
+               w_live, ocode):
+    """Flag-pass fold via the Pallas kernel: mark/poison [S, N] only
+    (deep_engine's commit-prefix-sharp flag pass, round 5)."""
+    ca_t, cv_t, cs_t, dm_t4 = tiles
+    win_t3 = (w_oa, w_val, w_live.astype(jnp.int32))
+    flag_t = _call_flags(cfg, ca_t, cv_t, cs_t, dm_t4, win_t3,
+                         st.horizon[None, :], ocode)
+    return dict(mark=(flag_t & F_MARK) != 0,
                 poison=(flag_t & F_POISON) != 0)
 
 

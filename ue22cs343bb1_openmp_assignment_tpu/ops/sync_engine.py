@@ -235,9 +235,8 @@ def continue_with_traces(cfg: SystemConfig, st: SyncState, traces=None,
         cfg, traces=traces, instr_arrays=instr_arrays)
     # phase boundary: reset the round counter and the round-tagged
     # claim/action columns, so the claim-key budget and action-tag
-    # namespace are per phase (metrics stay cumulative). asarray: a
-    # checkpoint-restored state carries host numpy arrays.
-    dm = jnp.asarray(st.dm).at[:, DM_CLAIM].set(jnp.iinfo(jnp.int32).max)
+    # namespace are per phase (metrics stay cumulative).
+    dm = reset_claims(st.dm)
     dm = dm.at[:, DM_ACT].set(-4)
     return st.replace(
         dm=dm,
@@ -272,6 +271,28 @@ def _assert_round_budget(cfg: SystemConfig, start_round, n: int) -> None:
         "continue_with_traces to reset the round counter")
 
 
+def reset_claims(dm):
+    """Clear DM_CLAIM to the idle sentinel (arbitration is transient
+    per-round state, never outcome). The ONE place the sentinel lives:
+    continue_with_traces resets at phase boundaries, and the CLI resets
+    on resume when a flag override changes the lane-key layout.
+    asarray: a checkpoint-restored state carries host numpy arrays."""
+    return jnp.asarray(dm).at[:, DM_CLAIM].set(jnp.iinfo(jnp.int32).max)
+
+
+def slot_bits(cfg: SystemConfig) -> int:
+    """Lane-key slot-index bit width (SB).
+
+    With absorption waves (deep_waves > 1) a node's same-entry events
+    carry their window slot index in the DM_CLAIM lane key so
+    re-touches compose across waves; single-wave configs spend no slot
+    bits. The ONE definition of the key layout's SB — deep_engine and
+    the CLI resume guard both use it, so a layout change cannot
+    silently diverge between the engine and the stale-claim reset."""
+    return (0 if cfg.deep_waves == 1
+            else max(1, (cfg.deep_slots - 1).bit_length()))
+
+
 def claim_max_rounds(cfg: SystemConfig) -> int:
     """Hard bound on rounds per machine (DM_CLAIM key-packing budget).
 
@@ -281,12 +302,14 @@ def claim_max_rounds(cfg: SystemConfig) -> int:
     prio_bits = max(1, (cfg.num_nodes - 1).bit_length())
     if cfg.deep_window:
         # one extra lane key bit (the ev tag) plus, with absorption
-        # waves, slot-index bits (same-entry program order); the
-        # wave-stamp DM_ACT packing (round << 11) further caps the
-        # absolute round counter at 2^20
-        sb = (0 if cfg.deep_waves == 1
-              else max(1, (cfg.deep_slots - 1).bit_length()))
-        return min((1 << (30 - prio_bits - 1 - sb)) - 1, (1 << 20) - 1)
+        # waves, slot-index bits (slot_bits), plus, with read storms,
+        # the is_rd bit above the priority field (ops/deep_engine key
+        # layout); the wave-stamp DM_ACT packing (round << 11) further
+        # caps the absolute round counter at 2^20
+        st_bit = 1 if cfg.deep_read_storm else 0
+        return min((1 << (30 - prio_bits - 1 - slot_bits(cfg)
+                          - st_bit)) - 1,
+                   (1 << 20) - 1)
     return (1 << (30 - prio_bits)) - 1
 
 

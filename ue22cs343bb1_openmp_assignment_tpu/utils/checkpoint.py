@@ -101,7 +101,15 @@ def load_checkpoint(path: str) -> Tuple[SystemConfig, SimState, dict]:
             f"(missing {_CONFIG_KEY}/{_META_KEY})")
     cfg_d = json.loads(bytes(arrays.pop(_CONFIG_KEY).tobytes()).decode())
     meta = json.loads(bytes(arrays.pop(_META_KEY).tobytes()).decode())
-    if meta.get("format_version") != FORMAT_VERSION:
+    version = meta.get("format_version")
+    if version == 3:
+        # v3 -> v4: the only layout change is the async mailbox ring
+        # going slot-major [N, Q, P] -> plane-major [P, N, Q]; sync
+        # checkpoints carry no mb_pack and need no migration
+        if "mb_pack" in arrays:
+            arrays["mb_pack"] = np.moveaxis(arrays["mb_pack"], -1, 0)
+        version = FORMAT_VERSION
+    if version != FORMAT_VERSION:
         raise ValueError(
             f"checkpoint format {meta.get('format_version')} != "
             f"supported {FORMAT_VERSION}")
