@@ -59,11 +59,15 @@ def main():
                     help="sync engine: independent machines batched into "
                          "one ensemble (different workload + arbitration "
                          "seeds); throughput is aggregated")
-    ap.add_argument("--deep-slots", type=int, default=3,
+    ap.add_argument("--deep-slots", type=int, default=None,
                     help="deep engine: remote-event slots per window "
-                         "(3 measured best at the headline config)")
-    ap.add_argument("--deep-g", type=int, default=2,
-                    help="deep engine: owner-value slots per window")
+                         "(default 3; 2 at >= 32768 nodes, where "
+                         "padded-slot occupancy falls and every "
+                         "[Q, N] index op prices empty slots — "
+                         "PERF.md scaling ladder)")
+    ap.add_argument("--deep-g", type=int, default=None,
+                    help="deep engine: owner-value slots per window "
+                         "(default 2; 1 at >= 32768 nodes)")
     ap.add_argument("--deep-waves", type=int, default=1,
                     help="deep engine: absorption waves — up to this "
                          "many same-class fill requests compose per "
@@ -154,6 +158,11 @@ def main():
                              txn_width=args.txn_width, **qkw)
     if args.engine == "deep":
         import dataclasses
+        big = args.nodes >= 32768
+        if args.deep_slots is None:
+            args.deep_slots = 2 if big else 3
+        if args.deep_g is None:
+            args.deep_g = 1 if big else 2
         cfg = dataclasses.replace(cfg, deep_window=True,
                                   deep_slots=args.deep_slots,
                                   deep_ownerval_slots=args.deep_g,

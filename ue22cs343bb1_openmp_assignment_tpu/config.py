@@ -107,12 +107,14 @@ class SystemConfig:
     # compose in one k-aggregated step (S count += k; an EM owner
     # flushes and downgrades via the wave stamps; U rows grant E to a
     # single reader, S to two or more — exactly the reference's
-    # read-after-read serialization, assignment.c:211-236). The
-    # granting node's window truncates after its first bulk slot (its
-    # storm read is its last committed event that round — program
-    # order). The many-readers-one-entry lever (lu's pivot rows,
-    # hotspot's read half); costs ~3 [Q, N] index ops per round, so
-    # off by default for low-contention workloads.
+    # read-after-read serialization, assignment.c:211-236). From its
+    # first storm slot onward a node's window is in the storm ZONE:
+    # further reads (and gated EVICT_SHARED notices) join the same
+    # terminal storm point, anything else truncates the window there
+    # (program order; ops/deep_engine). The many-readers-one-entry
+    # lever (lu's pivot rows, hotspot's read half); costs ~3 [Q, N]
+    # index ops per round plus a reads-always-storm lane-key bit, so
+    # off by default for low-contention or write-heavy workloads.
     deep_read_storm: bool = False
     # commit-prefix-exact marker/poison flags (round 5): derive the
     # home-side conflict flags from a lane-truncated flag-pass fold

@@ -489,11 +489,14 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     # pivot rows, hotspot's read half; assignment.c:211-236 is the
     # message-level original being batched). Soundness: a storm slot
     # is exactly a wave candidate (same poison/abort gating, same
-    # chain-yield lane-minimum argument), serialized after every wave;
-    # since a storm node may have lost arbitration elsewhere, its
-    # window TRUNCATES after its first storm slot (every later slot is
-    # marked bad), which keeps the committed stream a program-order
-    # prefix and keeps cross-entry serialization acyclic.
+    # chain-yield lane-minimum argument), serialized after every wave.
+    # From its first storm slot onward a node is in the storm ZONE:
+    # every further storm-eligible slot (reads; gated EVS notices)
+    # joins the SAME terminal serialization point — commuting ops at
+    # one point respect program order trivially — and any other slot
+    # kind is marked bad, truncating the window there, which keeps
+    # the committed stream a program-order prefix and cross-entry
+    # serialization acyclic.
     ev_abort = is_ev & ((got_flags & F_MARK) != 0) & home_wins
     if cfg.deep_read_storm:
         # storm ZONE: from the node's first losing (non-aborted) read
@@ -828,9 +831,11 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
         # the entry's lane key so duplicate scatter rows stay
         # bit-identical. The id sentinel is 0xFFFF: the promo fan-out's
         # not_self test must exclude NO real holder (any tag-matching
-        # valid line is a legitimate survivor of a storm promotion);
-        # config caps storm runs at num_nodes <= 65535 so the sentinel
-        # matches nobody.
+        # valid line is a legitimate survivor of a storm promotion).
+        # Config caps storm runs at num_nodes <= 32767 — the binding
+        # constraint is the evictor count packed as ke << 16 in an
+        # int32 scatter-add (sign bit at ke = 32768), which also keeps
+        # the sentinel matching nobody.
         if is_storm:
             multi = (kr + ke) >= 2
             req_col = jnp.where(multi, 0xFFFF, req_id)
