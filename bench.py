@@ -67,7 +67,9 @@ def main():
                          "PERF.md scaling ladder)")
     ap.add_argument("--deep-g", type=int, default=None,
                     help="deep engine: owner-value slots per window "
-                         "(default 2; 1 at >= 32768 nodes)")
+                         "(default 1 — over_g stops are negligible "
+                         "and each extra slot prices G*N gather "
+                         "indices per round)")
     ap.add_argument("--deep-waves", type=int, default=1,
                     help="deep engine: absorption waves — up to this "
                          "many same-class fill requests compose per "
@@ -162,7 +164,10 @@ def main():
         if args.deep_slots is None:
             args.deep_slots = 2 if big else 3
         if args.deep_g is None:
-            args.deep_g = 1 if big else 2
+            # one owner-value slot: over_g stops are ~0.007/node/round
+            # at G=2 and rounds stay identical at G=1 while each round
+            # sheds G*N gather indices (measured ~2-3% at 4096)
+            args.deep_g = 1
         cfg = dataclasses.replace(cfg, deep_window=True,
                                   deep_slots=args.deep_slots,
                                   deep_ownerval_slots=args.deep_g,

@@ -31,7 +31,8 @@ from ue22cs343bb1_openmp_assignment_tpu.state import init_state
 from ue22cs343bb1_openmp_assignment_tpu.utils.golden import (
     format_node_dump, state_to_dumps)
 
-from tests.test_outcome_inclusion import CASES, STORM_CASES, WAVE_CASES
+from tests.test_outcome_inclusion import (CASES, STORM_CASES,
+                                          WAVE_CASES, sync_outcomes)
 
 # dense grid: 0/1/2 catch mid-flight interleavings (a hop is ~1
 # cycle), 4/6/9/12/18 whole-transaction separations (~6 cycles/txn)
@@ -55,7 +56,13 @@ _NATIVE_CACHE = {}
 
 def native_outcomes_cached(cfg, key, traces):
     """native_outcomes memoized per trace (the four deep engine modes
-    check against the same message-level set)."""
+    check against the same message-level set). The cache key is the
+    case name, so every caller must enumerate under the one reference
+    config — asserted, or a config variant would silently reuse the
+    wrong outcome set."""
+    assert cfg == SystemConfig.reference(), (
+        "native_outcomes_cached keys on the case name only; "
+        "non-reference configs must call native_outcomes directly")
     if key not in _NATIVE_CACHE:
         _NATIVE_CACHE[key] = native_outcomes(cfg, traces)
     return _NATIVE_CACHE[key]
@@ -81,15 +88,9 @@ def native_outcomes(cfg, traces):
 
 
 def deep_outcomes(cfg, traces, seeds=range(16)):
-    import jax
-    out = {}
-    for seed in seeds:
-        st = se.from_sim_state(cfg, init_state(cfg, traces), seed=seed)
-        st = se.run_sync_to_quiescence(cfg, st, 4, 10_000)
-        assert bool(st.quiescent())
-        se.check_exact_directory(cfg, st)
-        out[_fp_sync(cfg, st)] = seed
-    return out
+    """test_outcome_inclusion.sync_outcomes with the dump-string
+    fingerprint this module shares with the native side."""
+    return sync_outcomes(cfg, traces, seeds=seeds, fp=_fp_sync)
 
 
 def _deep_cfg(waves, storm):
@@ -120,9 +121,10 @@ def test_deep_outcomes_within_native_enumeration(name, waves, storm):
 
 
 def _random_trace(rng):
-    """A 4-node micro-trace over two hot remote blocks plus one local
-    touch per node — the contention shapes (fills, upgrades, notices,
-    storms) arise from cache-slot conflicts on 0x2_/0x3_ addresses."""
+    """A 4-node micro-trace of 1-3 ops per node over four hot blocks
+    homed at nodes 2 and 3 — 0x20/0x24 and 0x30 conflict on cache
+    slots, so fills, upgrades, eviction notices, and storms all arise
+    from the same small address set."""
     blocks = [0x20, 0x30, 0x24, 0x21]
     traces = []
     for n in range(4):
@@ -133,8 +135,6 @@ def _random_trace(rng):
             val = int(rng.integers(1, 100))
             tr.append((op, addr, val if op else 0))
         traces.append(tr)
-    if not any(traces):
-        traces[0] = [(1, 0x20, 7)]
     return traces
 
 

@@ -61,14 +61,18 @@ def async_outcomes(cfg, traces, max_delay=6, delay_step=2, n_ranks=4):
     return out
 
 
-def sync_outcomes(cfg, traces, seeds=range(12)):
+def sync_outcomes(cfg, traces, seeds=range(12), fp=None):
+    """fp: fingerprint callable (cfg, state) -> key; defaults to the
+    binary fingerprint_sync (tests/test_native_enumeration.py passes
+    its dump-string fingerprint instead)."""
+    fp = fp or fingerprint_sync
     out = {}
     for seed in seeds:
         st = se.from_sim_state(cfg, init_state(cfg, traces), seed=seed)
         st = se.run_sync_to_quiescence(cfg, st, 4, 10_000)
         assert bool(st.quiescent())
         se.check_exact_directory(cfg, st)
-        out[fingerprint_sync(cfg, st)] = seed
+        out[fp(cfg, st)] = seed
     return out
 
 
