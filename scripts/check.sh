@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# CI gate: static analysis first (fast, catches protocol and tracing
+# regressions without running a workload), then the fast test tier.
+#
+#   scripts/check.sh            # analyze + tier-1 tests
+#   scripts/check.sh --analyze  # static analysis only
+#
+# The analyze step is `cache-sim analyze`: the small-scope protocol
+# model checker over the builtin scopes plus the JAX trace linter over
+# ops/ parallel/ models/. It exits nonzero on any genuine violation
+# (reference-sanctioned quirks are reported but allowlisted).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+python -m ue22cs343bb1_openmp_assignment_tpu.analysis ${ANALYZE_ARGS:-}
+
+if [[ "${1:-}" == "--analyze" ]]; then
+    exit 0
+fi
+
+python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider

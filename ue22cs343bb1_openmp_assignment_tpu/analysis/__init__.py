@@ -1,0 +1,21 @@
+"""Static-analysis subsystem: protocol model checking + JAX trace lint.
+
+Two passes, both CI-gating (``cache-sim analyze``, ``scripts/check.sh``):
+
+* :mod:`.model_check` — small-scope explicit-state model checker that
+  drives the real vectorized handlers (ops/handlers, ops/frontend) as a
+  transition oracle over every message interleaving of tiny
+  configurations, verifying handler coverage, the engine-tier
+  invariants everywhere, the coherence contract at every quiescent
+  state, and deadlock/livelock freedom.
+* :mod:`.lint_trace` — AST linter for the traced JAX modules (ops/,
+  parallel/, models/): Python branching on traced values, host syncs
+  and callbacks inside traced code, implicit integer dtypes, banned
+  nondeterminism sources.
+
+:mod:`.mutations` holds seeded handler bugs that the checker must
+catch (the checker's own regression suite), :mod:`.runner` the CLI.
+"""
+
+from ue22cs343bb1_openmp_assignment_tpu.analysis.model_check import (  # noqa: F401
+    ModelChecker, Scope, builtin_scopes, check_scope)

@@ -1,0 +1,8 @@
+"""`python -m ue22cs343bb1_openmp_assignment_tpu.analysis` == `cache-sim analyze`."""
+
+import sys
+
+from ue22cs343bb1_openmp_assignment_tpu.analysis.runner import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,541 @@
+"""AST linter for the traced JAX modules (ops/, parallel/, models/).
+
+The engine's contract is that everything inside a traced function is
+branch-free, device-resident, int32-disciplined and deterministic —
+the properties the vectorized cycle depends on and that silently break
+when someone writes ordinary Python in a handler. This pass enforces
+them statically, per function, with a light value-taint analysis:
+
+* ``traced-branch`` — Python ``if``/``while``/``assert`` (or a
+  ternary / comprehension guard) whose condition is a traced value,
+  and ``range()``/``reversed()``/``enumerate()`` over a traced length.
+  Under ``jax.jit`` these either raise ConcretizationTypeError or, in
+  op-by-op mode, silently pick one branch per trace. Plain ``for``
+  over an array or a container of arrays is NOT flagged — that is
+  static unrolling, the engine's idiom for small fixed bounds.
+* ``host-sync`` — ``.item()`` / ``.tolist()`` / ``int()`` / ``bool()``
+  / ``float()`` on a traced value: a blocking device→host transfer.
+* ``host-call`` — ``np.*``, ``print``, ``jax.pure_callback``,
+  ``io_callback``, ``jax.debug.print``/``callback`` inside traced
+  code: host round-trips that break the pure-XLA execution model.
+* ``dtype-drift`` — ``jnp.arange``/``zeros``/``ones``/``empty``/
+  ``full`` without an explicit dtype: JAX defaults can disagree with
+  the engine's int32 lattice (and with x64 mode).
+* ``nondeterminism`` — ``random``/``np.random``/``time``/``datetime``
+  /``os.urandom``/``uuid``/``secrets`` in traced code, plus
+  module-level imports of ``random``/``secrets``/``uuid`` anywhere in
+  the linted packages. Simulation results must be a pure function of
+  (config, traces, fault_key).
+
+Taint model (deliberately under-approximate to stay quiet): function
+parameters are traced unless they are ``self``/``cls``/``cfg``/
+``config``/``mesh``, have a Python-literal default, or carry a scalar
+Python annotation (``int``/``bool``/``float``/``str``); results of
+``jnp.``/``jax.``/``lax.`` calls are traced; taint propagates through
+arithmetic, subscripts, attributes and method calls, and dies at
+``.shape``/``.ndim``/``.dtype``/``.size``/``len()``,
+``jax.device_get``, identity tests (``is``/``is not``) and container
+literals/comprehensions (a Python list of arrays is a host container
+— only its *elements* are traced). Unknown local calls are assumed
+host values.
+
+Host-side functions opt out of the tracing rules (not of
+``dtype-drift``) by saying so: the string ``host-side`` anywhere in
+the docstring, or a ``# lint: host`` comment on the ``def`` line or
+the line above. The escape hatch is visible in the diff, which is the
+point.
+
+Public API: :func:`lint_source` (unit tests), :func:`lint_file`,
+:func:`lint_paths`, :func:`default_targets`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+from typing import Iterable, List, Optional, Sequence
+
+#: module roots whose call results are traced values
+_TRACED_ROOTS = {"jnp", "jax", "lax"}
+#: parameter names that are never traced values
+_HOST_PARAMS = {"self", "cls", "cfg", "config", "mesh"}
+#: scalar Python annotations that mark a parameter as a host value
+_SCALAR_ANNOTATIONS = {"int", "bool", "float", "str", "bytes"}
+#: attribute reads that yield static (host) metadata even on traced values
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type", "sharding"}
+#: builtins whose application to a traced value is a device→host sync
+_SYNC_BUILTINS = {"int", "bool", "float", "complex"}
+#: method names that force a device→host sync
+_SYNC_METHODS = {"item", "tolist"}
+#: builtins returning host values (no finding, kills taint)
+_HOST_BUILTINS = {"len", "isinstance", "getattr", "hasattr", "id", "repr",
+                  "str", "format", "type", "max", "min", "abs", "round",
+                  "sorted", "sum", "tuple", "list", "dict", "set", "range",
+                  "zip", "enumerate", "divmod"}
+#: builtins needing a concrete integer — traced args are a trace error
+_CONCRETE_LEN_BUILTINS = {"range", "reversed"}
+#: calls whose result is a host value even though the root is jax
+_HOST_RESULT_CALLS = {"jax.device_get", "jax.block_until_ready",
+                      "jax.tree_util.tree_structure"}
+#: dotted prefixes that are host round-trips inside traced code
+_HOST_CALL_PREFIXES = ("np.", "numpy.", "jax.pure_callback",
+                       "jax.experimental.io_callback", "io_callback",
+                       "jax.debug.print", "jax.debug.callback",
+                       "jax.debug.breakpoint")
+#: dotted prefixes that are nondeterminism sources inside traced code
+_NONDET_PREFIXES = ("random.", "np.random.", "numpy.random.", "time.",
+                    "datetime.", "os.urandom", "uuid.", "secrets.")
+#: modules whose import is banned outright in the linted packages
+_NONDET_IMPORTS = {"random", "secrets", "uuid"}
+#: jnp constructors and the signature slot their dtype occupies
+#: (number of positional args after which dtype is positional)
+_DTYPE_CTORS = {"arange": None, "zeros": 1, "ones": 1, "empty": 1,
+                "full": 2, "zeros_like": None, "ones_like": None,
+                "full_like": None}
+#: ctors where the _like/arange form may inherit dtype — only flag when
+#: neither a dtype kwarg nor an inheriting base is present
+_INHERIT_OK = {"zeros_like", "ones_like", "full_like"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One linter hit: ``file:line:col rule func: msg``."""
+
+    file: str
+    line: int
+    col: int
+    rule: str
+    func: str
+    msg: str
+
+    def render(self) -> str:
+        where = f"{self.file}:{self.line}:{self.col}"
+        return f"{where}: [{self.rule}] in `{self.func}`: {self.msg}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """`a.b.c` -> "a.b.c" (None for anything not a pure dotted name)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.operand,
+                                                    ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_literal(e) for e in node.elts)
+    return False
+
+
+def _scalar_annotation(node: Optional[ast.AST]) -> bool:
+    if node is None:
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in _SCALAR_ANNOTATIONS
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in _SCALAR_ANNOTATIONS
+    if isinstance(node, ast.Subscript):  # Optional[int] etc.
+        return _scalar_annotation(node.slice)
+    return False
+
+
+class _FunctionLint:
+    """Lints one function body with a forward taint pass."""
+
+    def __init__(self, fn: ast.AST, filename: str, src_lines: Sequence[str],
+                 findings: List[Finding],
+                 inherited: Optional[set] = None) -> None:
+        self.fn = fn
+        self.filename = filename
+        self.src_lines = src_lines
+        self.findings = findings
+        self.qualname = fn.name
+        self.host_side = self._host_exempt()
+        self.tainted: set = set(inherited or ())
+        self._seed_params()
+
+    # -- setup ---------------------------------------------------------
+    def _host_exempt(self) -> bool:
+        doc = ast.get_docstring(self.fn) or ""
+        if "host-side" in doc.lower() or "host side" in doc.lower():
+            return True
+        for ln in range(max(self.fn.lineno - 2, 1), self.fn.lineno + 1):
+            if ln - 1 < len(self.src_lines) and \
+                    "lint: host" in self.src_lines[ln - 1]:
+                return True
+        return False
+
+    def _seed_params(self) -> None:
+        a = self.fn.args
+        params = list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+        defaults = list(a.defaults)
+        # align defaults with the tail of posonly+args
+        pos = list(a.posonlyargs) + list(a.args)
+        defaulted = {p.arg for p, d in zip(pos[len(pos) - len(defaults):],
+                                           defaults) if _is_literal(d)}
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            if d is not None and _is_literal(d):
+                defaulted.add(p.arg)
+        for p in params:
+            if p.arg in _HOST_PARAMS or p.arg in defaulted:
+                continue
+            if _scalar_annotation(p.annotation):
+                continue
+            self.tainted.add(p.arg)
+        if a.vararg:
+            self.tainted.add(a.vararg.arg)
+
+    # -- reporting -----------------------------------------------------
+    def _hit(self, node: ast.AST, rule: str, msg: str) -> None:
+        self.findings.append(Finding(
+            self.filename, getattr(node, "lineno", self.fn.lineno),
+            getattr(node, "col_offset", 0), rule, self.qualname, msg))
+
+    # -- expression taint (records findings as a side effect) ----------
+    def taint(self, node: ast.AST) -> bool:
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            base = self.taint(node.value)
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return base
+        if isinstance(node, ast.Subscript):
+            self.taint(node.slice)
+            return self.taint(node.value)
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, ast.BinOp):
+            lt = self.taint(node.left)
+            rt = self.taint(node.right)
+            return lt or rt
+        if isinstance(node, ast.UnaryOp):
+            return self.taint(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any([self.taint(v) for v in node.values])
+        if isinstance(node, ast.Compare):
+            t = self.taint(node.left)
+            for c in node.comparators:
+                t = self.taint(c) or t
+            if all(isinstance(o, (ast.Is, ast.IsNot)) for o in node.ops):
+                return False    # identity tests are host-decidable
+            return t
+        if isinstance(node, ast.IfExp):
+            if self.taint(node.test) and not self.host_side:
+                self._hit(node, "traced-branch",
+                          "ternary on a traced value (use jnp.where)")
+            return self.taint(node.body) or self.taint(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            for e in node.elts:
+                self.taint(e)
+            return False        # a host container OF traced values
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                self.taint(k)
+                self.taint(v)
+            return False
+        if isinstance(node, ast.Starred):
+            return self.taint(node.value)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            return self._comprehension(node)
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                self.taint(v)
+            return False
+        if isinstance(node, ast.FormattedValue):
+            if self.taint(node.value) and not self.host_side:
+                self._hit(node, "host-sync",
+                          "formatting a traced value forces a host sync")
+            return False
+        if isinstance(node, ast.Slice):
+            t = self.taint(node.lower) or self.taint(node.upper)
+            return self.taint(node.step) or t
+        if isinstance(node, ast.NamedExpr):
+            t = self.taint(node.value)
+            if t:
+                self.tainted.add(node.target.id)
+            return t
+        if isinstance(node, ast.Lambda):
+            return False
+        return False
+
+    def _comprehension(self, node: ast.AST) -> bool:
+        for gen in node.generators:
+            it = self.taint(gen.iter)
+            for tgt in ast.walk(gen.target):
+                if isinstance(tgt, ast.Name):
+                    if it:
+                        self.tainted.add(tgt.id)
+                    else:
+                        self.tainted.discard(tgt.id)
+            for guard in gen.ifs:
+                if self.taint(guard) and not self.host_side:
+                    self._hit(guard, "traced-branch",
+                              "comprehension guard on a traced value")
+        if isinstance(node, ast.DictComp):
+            self.taint(node.key)
+            self.taint(node.value)
+        else:
+            self.taint(node.elt)
+        return False            # comprehensions build host containers
+
+    def _call(self, node: ast.Call) -> bool:
+        arg_taints = [self.taint(a) for a in node.args]
+        for kw in node.keywords:
+            arg_taints.append(self.taint(kw.value))
+        any_tainted_arg = any(arg_taints)
+        name = _dotted(node.func)
+
+        if name is not None:
+            root = name.split(".", 1)[0]
+            self._check_dtype_ctor(node, name)
+            if not self.host_side:
+                self._check_host_call(node, name)
+                self._check_nondet(node, name)
+            if name in _SYNC_BUILTINS and any_tainted_arg:
+                if not self.host_side:
+                    self._hit(node, "host-sync",
+                              f"{name}() on a traced value blocks on a "
+                              "device->host transfer")
+                return False
+            if name in _CONCRETE_LEN_BUILTINS and any_tainted_arg and \
+                    not self.host_side:
+                self._hit(node, "traced-branch",
+                          f"{name}() over a traced length (use "
+                          "lax.fori_loop / lax.scan, or a static bound "
+                          "from cfg)")
+            if name in _HOST_BUILTINS:
+                return False
+            if name in _HOST_RESULT_CALLS:
+                return False
+            if root in _TRACED_ROOTS:
+                return True
+
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in _SYNC_METHODS and \
+                    self.taint(node.func.value):
+                if not self.host_side:
+                    self._hit(node, "host-sync",
+                              f".{node.func.attr}() on a traced value "
+                              "blocks on a device->host transfer")
+                return False
+            # method on a traced value (.astype, .sum, .at[...].set)
+            return self.taint(node.func.value)
+        # unknown local helper: assume host result (under-approximate)
+        return False
+
+    # -- per-call rule checks ------------------------------------------
+    def _check_dtype_ctor(self, node: ast.Call, name: str) -> None:
+        parts = name.split(".")
+        if len(parts) != 2 or parts[0] != "jnp":
+            return
+        ctor = parts[1]
+        if ctor not in _DTYPE_CTORS:
+            return
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            return
+        slot = _DTYPE_CTORS[ctor]
+        if slot is not None and len(node.args) > slot:
+            return      # dtype passed positionally
+        if ctor in _INHERIT_OK:
+            return      # *_like inherits its base's dtype
+        self._hit(node, "dtype-drift",
+                  f"jnp.{ctor} without an explicit dtype — the engine "
+                  "is int32-disciplined; JAX's default can drift")
+
+    def _check_host_call(self, node: ast.Call, name: str) -> None:
+        if name == "print":
+            self._hit(node, "host-call",
+                      "print() in traced code is a host round-trip "
+                      "(use jax.debug.print only in debug paths, or "
+                      "mark the function host-side)")
+            return
+        for pref in _HOST_CALL_PREFIXES:
+            if name == pref.rstrip(".") or name.startswith(pref):
+                self._hit(node, "host-call",
+                          f"`{name}` in traced code leaves the XLA "
+                          "program (host callback / numpy op)")
+                return
+
+    def _check_nondet(self, node: ast.Call, name: str) -> None:
+        for pref in _NONDET_PREFIXES:
+            if name == pref.rstrip(".") or name.startswith(pref):
+                self._hit(node, "nondeterminism",
+                          f"`{name}` in traced code — simulation output "
+                          "must be a pure function of (config, traces, "
+                          "fault_key)")
+                return
+
+    # -- statements ----------------------------------------------------
+    def run(self) -> None:
+        for stmt in self.fn.body:
+            self._stmt(stmt)
+
+    def _assign_target(self, tgt: ast.AST, tainted: bool) -> None:
+        if isinstance(tgt, ast.Name):
+            if tainted:
+                self.tainted.add(tgt.id)
+            else:
+                self.tainted.discard(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._assign_target(e, tainted)
+        elif isinstance(tgt, ast.Starred):
+            self._assign_target(tgt.value, tainted)
+        # attribute/subscript targets: no local binding to track
+
+    def _stmt(self, stmt: ast.AST) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sub = _FunctionLint(stmt, self.filename, self.src_lines,
+                                self.findings, inherited=self.tainted)
+            sub.qualname = f"{self.qualname}.{stmt.name}"
+            sub.host_side = sub.host_side or self.host_side
+            sub.run()
+            return
+        if isinstance(stmt, (ast.Assign,)):
+            t = self.taint(stmt.value)
+            for tgt in stmt.targets:
+                self._assign_target(tgt, t)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            t = self.taint(stmt.value) if stmt.value is not None else False
+            self._assign_target(stmt.target, t)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            t = self.taint(stmt.value)
+            if isinstance(stmt.target, ast.Name):
+                if t:
+                    self.tainted.add(stmt.target.id)
+            return
+        if isinstance(stmt, ast.If):
+            if self.taint(stmt.test) and not self.host_side:
+                self._hit(stmt, "traced-branch",
+                          "Python `if` on a traced value (use jnp.where "
+                          "/ lax.select / lax.cond)")
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.While):
+            if self.taint(stmt.test) and not self.host_side:
+                self._hit(stmt, "traced-branch",
+                          "Python `while` on a traced value (use "
+                          "lax.while_loop)")
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.For):
+            # iterating an array is static unrolling (legal); only a
+            # traced *length* breaks tracing — caught at range() above
+            self._assign_target(stmt.target, self.taint(stmt.iter))
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Assert):
+            if self.taint(stmt.test) and not self.host_side:
+                self._hit(stmt, "traced-branch",
+                          "assert on a traced value (use "
+                          "ops.invariants / checkify)")
+            return
+        if isinstance(stmt, ast.Return):
+            self.taint(stmt.value)
+            return
+        if isinstance(stmt, ast.Expr):
+            self.taint(stmt.value)
+            return
+        if isinstance(stmt, (ast.With,)):
+            for item in stmt.items:
+                self.taint(item.context_expr)
+            for s in stmt.body:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Try):
+            for s in (stmt.body + stmt.orelse + stmt.finalbody +
+                      [h for hh in stmt.handlers for h in hh.body]):
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Raise):
+            self.taint(stmt.exc)
+            return
+        # Pass / Import / Global / Nonlocal / Delete / Break / Continue
+
+
+def lint_source(src: str, filename: str = "<string>") -> List[Finding]:
+    """Lint one module's source text; returns findings (possibly empty)."""
+    tree = ast.parse(src, filename=filename)
+    src_lines = src.splitlines()
+    findings: List[Finding] = []
+
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Import):
+            for alias in stmt.names:
+                if alias.name.split(".", 1)[0] in _NONDET_IMPORTS:
+                    findings.append(Finding(
+                        filename, stmt.lineno, stmt.col_offset,
+                        "nondeterminism", "<module>",
+                        f"module-level `import {alias.name}` — banned "
+                        "nondeterminism source in engine code"))
+        elif isinstance(stmt, ast.ImportFrom):
+            if stmt.module and stmt.module.split(".", 1)[0] in \
+                    _NONDET_IMPORTS:
+                findings.append(Finding(
+                    filename, stmt.lineno, stmt.col_offset,
+                    "nondeterminism", "<module>",
+                    f"module-level `from {stmt.module} import ...` — "
+                    "banned nondeterminism source in engine code"))
+
+    def walk_defs(body, prefix=""):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fl = _FunctionLint(stmt, filename, src_lines, findings)
+                if prefix:
+                    fl.qualname = f"{prefix}.{stmt.name}"
+                fl.run()
+            elif isinstance(stmt, ast.ClassDef):
+                walk_defs(stmt.body, prefix=f"{prefix}.{stmt.name}"
+                          if prefix else stmt.name)
+
+    walk_defs(tree.body)
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_file(path) -> List[Finding]:
+    p = pathlib.Path(path)
+    return lint_source(p.read_text(), filename=str(p))
+
+
+def default_targets() -> List[pathlib.Path]:
+    """The traced packages this linter gates: ops/, parallel/, models/."""
+    pkg = pathlib.Path(__file__).resolve().parents[1]
+    return [pkg / d for d in ("ops", "parallel", "models") if
+            (pkg / d).is_dir()]
+
+
+def lint_paths(paths: Optional[Iterable] = None) -> List[Finding]:
+    """Lint every ``*.py`` under the given files/dirs (default targets
+    when none are given); returns all findings sorted by location."""
+    targets = [pathlib.Path(p) for p in paths] if paths else \
+        default_targets()
+    findings: List[Finding] = []
+    for t in targets:
+        files = sorted(t.rglob("*.py")) if t.is_dir() else [t]
+        for f in files:
+            findings.extend(lint_file(f))
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return findings

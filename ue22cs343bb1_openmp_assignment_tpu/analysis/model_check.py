@@ -1,0 +1,711 @@
+"""Small-scope explicit-state model checker for the coherence protocol.
+
+The dynamic checker (ops/invariants.py) judges only the states a
+particular workload happens to reach. This pass gives the complementary
+*static* guarantee over tiny configurations: enumerate EVERY state a
+2–3-node, 1–2-address machine can reach under ALL message
+interleavings, and verify the protocol on the whole graph. The
+transition oracle is the *shipped engine itself* — each explored
+transition stages a concrete :class:`~..state.SimState` and runs one
+real ``ops.step.cycle`` (so ``ops/handlers.py`` + ``ops/frontend.py``
+are the checked artifact, never a re-model of them).
+
+**Interleaving semantics.** One node acts per step: either it dequeues
+and handles its head message, or (empty queue, not blocked, trace
+remaining) it fetches one instruction. Handlers only ever write the
+processing node's own state row and communicate via messages
+(``assignment.c:190-618``), so every synchronous engine cycle is a
+linearization of these per-node steps — the one-at-a-time graph covers
+all cross-sender arbitration orders the engine's seedable ``arb_rank``
+can realize, and more. Node isolation uses the engine's own schedule
+gate: the acting node gets ``issue_delay=0``, everyone else
+``issue_delay=BIG`` (and only the acting node's queue is staged), so
+exactly one node moves per oracle call.
+
+**Checks.**
+
+* *handler coverage* — every dequeued (message, receiver-state) pair
+  must engage the handler matrix (some masked update, wait-flag clear,
+  or outgoing candidate). A silent no-op is flagged unless it is a
+  reference-sanctioned one (INV on a tag mismatch,
+  ``assignment.c:389-399``).
+* *engine-tier invariants* — :func:`..ops.invariants.step_predicates`
+  must hold on every reachable state (shared definitions, not copies).
+* *coherence tier* — :func:`..ops.invariants.quiescent_predicates` at
+  every quiescent terminal state. Findings whose names sit in
+  :data:`QUIRK_ALLOWLIST` are reported as sanctioned reference quirks
+  (SURVEY §2: the protocol tracks no INV-acks, so a racing fill can
+  legally strand a stale copy); everything else is a genuine violation.
+* *progress* — no deadlock (terminal state with a blocked node) and no
+  livelock (reachable state from which no terminal state is reachable).
+
+Reports are machine-readable dicts (JSON-stable ordering) with
+counterexample paths from the initial state; analysis/runner.py renders
+the human diff-style view. analysis/mutations.py seeds handler bugs
+this checker must catch — its regression suite.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from ue22cs343bb1_openmp_assignment_tpu import codec
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.ops import handlers, invariants, \
+    mailbox, step
+from ue22cs343bb1_openmp_assignment_tpu.state import (MB_BV0, MB_TYPE,
+                                                      Metrics, SimState,
+                                                      init_state)
+from ue22cs343bb1_openmp_assignment_tpu.types import (CACHE_STATE_NAMES,
+                                                      DIR_STATE_NAMES, Msg,
+                                                      Op)
+
+# blocks the frontend issue gate for non-acting nodes (state.issue_delay)
+BIG_DELAY = 1 << 20
+# fixed oracle batch width: every vmapped call shares one compilation
+_BATCH = 64
+
+# Coherence-tier findings the *reference protocol itself* produces at
+# quiescence — reported, never silenced, and never counted as failures.
+# Root cause for all of them: the protocol tracks no INV-acks
+# (``assignment.c:358-361``), so an INV that races an in-flight fill can
+# be processed first (tag mismatch -> sanctioned no-op), after which the
+# fill installs a copy the directory no longer knows about; the
+# blind-by-index WRITEBACK handlers (quirk 5, ``assignment.c:558,586``)
+# can similarly resurrect a stale line. Both orderings are legal
+# reference behavior (SURVEY §4's accepted run_* variants).
+QUIRK_ALLOWLIST = {
+    "valid_line_unknown_to_home":
+        "stale copy from the unacked-INV race: the directory dropped "
+        "this sharer while its fill was in flight (assignment.c:358-361)",
+    "phantom_sharers":
+        "copy census vs directory popcount disagrees wherever a stale "
+        "line survives the unacked-INV race",
+    "owner_with_other_copies":
+        "the new owner coexists with the stale copy the unacked INV "
+        "failed to kill (assignment.c:358-361)",
+    "clean_line_stale_value":
+        "a stale SHARED copy keeps the pre-race value after home memory "
+        "moved on (the reference would serve the same stale read)",
+    "shared_line_dir_unowned":
+        "stale SHARED copy outliving its directory entry "
+        "(EVICT/INV race; blind-by-index writes, quirk 5)",
+    "exclusive_line_dir_not_em":
+        "directory-update timing (quirk 4): a stale FLUSH from a "
+        "superseded WRITEBACK_INT demotes the directory to S after a "
+        "racing write already granted EM, and the FLUSH_INVACK home "
+        "handler restores only the bitvector, never the state "
+        "(assignment.c:199-210,455-457,510-529)",
+}
+
+
+class ScopeTooLarge(RuntimeError):
+    """Raised when a scope's reachable graph exceeds max_states."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Scope:
+    """One model-checking configuration: dimensions + per-node programs."""
+
+    name: str
+    cfg: SystemConfig
+    programs: tuple  # per node: tuple of (Op, addr, value)
+
+    def __post_init__(self):
+        if self.cfg.bitvec_words != 1 or self.cfg.msg_bitvec_words != 1:
+            raise ValueError("scopes assume 1-word sharer bitvectors")
+        if self.cfg.inv_mode != "mailbox":
+            raise ValueError("scopes drive the exact-reference mailbox "
+                             "INV path")
+        if len(self.programs) != self.cfg.num_nodes:
+            raise ValueError("need exactly one program per node")
+        if max(len(p) for p in self.programs) > self.cfg.max_instrs:
+            raise ValueError("program longer than cfg.max_instrs")
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name,
+            "num_nodes": self.cfg.num_nodes,
+            "cache_size": self.cfg.cache_size,
+            "mem_size": self.cfg.mem_size,
+            "programs": [[[Op(op).name, int(a), int(v)] for op, a, v in p]
+                         for p in self.programs],
+        }
+
+
+def builtin_scopes() -> dict:
+    """The shipped small scopes (all addresses home on node 0).
+
+    * ``2n1a`` — 2 nodes, one address, read/write races on it: the
+      READ/WRITE_REQUEST, REPLY_*, WRITEBACK_*, FLUSH*, UPGRADE and INV
+      paths, including every home==requester dedup quirk.
+    * ``2n2a`` — 2 nodes, two addresses conflicting on one direct-mapped
+      line: adds the EVICT_SHARED / EVICT_MODIFIED replacement paths.
+    * ``3n1a`` — 3 nodes, one address: multi-sharer directory states,
+      REPLY_ID fan-out to >1 sharer, EVICT_SHARED owner promotion.
+    * ``2n1a_r`` — 2 nodes, one remote-homed address, reads only. The
+      liveness scope: in the write scopes a lost reply-unblock is
+      masked by quirk 2 (FLUSH/FLUSH_INVACK clear `waiting`
+      unconditionally, ``assignment.c:322,535``), so write traffic
+      rescues a stranded reader; with reads only, every reply must do
+      its own unblocking or the checker sees a deadlock.
+    """
+    cfg2 = SystemConfig(num_nodes=2, cache_size=1, mem_size=2,
+                        queue_capacity=16, max_instrs=4, inv_mode="mailbox")
+    a = codec.make_address(cfg2, 0, 0)
+    b = codec.make_address(cfg2, 0, 1)
+    r = codec.make_address(cfg2, 1, 0)
+    cfg3 = SystemConfig(num_nodes=3, cache_size=1, mem_size=2,
+                        queue_capacity=16, max_instrs=4, inv_mode="mailbox")
+    a3 = codec.make_address(cfg3, 0, 0)
+    R, W = int(Op.READ), int(Op.WRITE)
+    scopes = [
+        Scope("2n1a", cfg2, (
+            ((R, a, 0), (W, a, 5)),
+            ((W, a, 7), (R, a, 0)),
+        )),
+        Scope("2n2a", cfg2, (
+            ((W, a, 3), (R, b, 0), (R, a, 0)),
+            ((W, b, 9), (R, a, 0)),
+        )),
+        Scope("3n1a", cfg3, (
+            ((R, a3, 0),),
+            ((R, a3, 0),),
+            ((W, a3, 4),),
+        )),
+        Scope("2n1a_r", cfg2, (
+            ((R, r, 0),),
+            ((R, r, 0),),
+        )),
+    ]
+    return {s.name: s for s in scopes}
+
+
+@dataclasses.dataclass(frozen=True)
+class AState:
+    """Canonical (hashable) abstraction of one machine state.
+
+    Everything transition-relevant and nothing else: cache/memory/
+    directory contents, per-node trace position, block flag, the
+    latched in-flight instruction (quirk 1 fills read it — and quirk 2
+    can clear `waiting` with the reply still in flight, so the latch
+    matters even for non-waiting nodes), and per-node FIFO message
+    queues. Excluded as observationally irrelevant: cycle counters,
+    metrics, waiting_since, mailbox ring phase (head position).
+    """
+
+    cache_addr: tuple   # [N][C]
+    cache_val: tuple
+    cache_state: tuple
+    memory: tuple       # [N][M]
+    dir_state: tuple
+    dir_bitvec: tuple   # [N][M] ints (single u32 word)
+    instr_idx: tuple    # [N]
+    waiting: tuple      # [N] bool
+    cur_op: tuple       # [N]
+    cur_addr: tuple
+    cur_val: tuple
+    queues: tuple       # [N] tuples of (type, sender, addr, value,
+                        #                second, dirstate, bv_word)
+
+
+def _t2(arr) -> tuple:
+    return tuple(tuple(int(x) for x in row) for row in np.asarray(arr))
+
+
+def _t1(arr) -> tuple:
+    return tuple(int(x) for x in np.asarray(arr))
+
+
+def enabled_events(scope: Scope, a: AState) -> list:
+    """Events runnable from `a`: per node, dequeue-one-message XOR
+    fetch-one-instruction — the reference's drain-first priority
+    (``assignment.c:165-177,624-629``) per node."""
+    evs = []
+    for n in range(scope.cfg.num_nodes):
+        if a.queues[n]:
+            evs.append(("msg", n))
+        elif not a.waiting[n] and a.instr_idx[n] < len(scope.programs[n]) - 1:
+            evs.append(("instr", n))
+    return evs
+
+
+class ModelChecker:
+    """Explicit-state BFS over one scope's reachable graph.
+
+    ``message_phase`` swaps in a (possibly mutated) handler phase with
+    the signature of :func:`..ops.handlers.message_phase`; the engine
+    around it stays the shipped one (ops/step.cycle's override hook).
+    """
+
+    def __init__(self, scope: Scope, message_phase=None,
+                 max_states: int = 50_000):
+        self.scope = scope
+        self.cfg = scope.cfg
+        self.max_states = max_states
+        mp = message_phase if message_phase is not None \
+            else handlers.message_phase
+        cfg = self.cfg
+
+        def one(state):
+            new_state = step.cycle(cfg, state, message_phase=mp)
+            # handler-engagement probe on the SAME staged state: did the
+            # dequeued message trigger any masked write, wait clear, or
+            # outgoing candidate at its receiver?
+            mv, _, _ = mailbox.dequeue(cfg, state)
+            upd, cand, inv_scatter, _ = mp(cfg, state, mv)
+            engaged = (upd["cache_state"][0] | upd["cache_addr"][0]
+                       | upd["mem"][0] | upd["dir_state"][0]
+                       | upd["dir_bv"][0] | upd["wait_clear"])
+            import jax.numpy as jnp
+            for part in ("pri", "sec", "ev"):
+                engaged = engaged | (cand[part][0] != int(Msg.NONE))
+            if cand["inv"][0] is not None:
+                engaged = engaged | jnp.any(
+                    cand["inv"][0] != int(Msg.NONE), axis=1)
+            if inv_scatter is not None:
+                engaged = engaged | inv_scatter[0]
+            return new_state, engaged
+
+        self._oracle = jax.jit(jax.vmap(one))
+        self._step_preds = jax.jit(jax.vmap(
+            lambda s: invariants.step_predicates(cfg, s)))
+        self._quiet_preds = jax.jit(jax.vmap(
+            lambda s: invariants.quiescent_predicates(cfg, s)))
+        self._instr_arrays = self._build_instr_arrays()
+        self._fault_key = np.asarray(
+            jax.device_get(init_state(cfg).fault_key), np.uint32)
+
+    # -- staging: AState -> concrete SimState (numpy leaves) --------------
+
+    def _build_instr_arrays(self):
+        cfg = self.cfg
+        N, T = cfg.num_nodes, cfg.max_instrs
+        op = np.full((N, T), int(Op.NOP), np.int32)
+        addr = np.zeros((N, T), np.int32)
+        val = np.zeros((N, T), np.int32)
+        count = np.zeros((N,), np.int32)
+        for n, prog in enumerate(self.scope.programs):
+            count[n] = len(prog)
+            for i, (o, a, v) in enumerate(prog):
+                op[n, i], addr[n, i], val[n, i] = int(o), int(a), int(v) & 0xFF
+        return op, addr, val, count
+
+    def _stage(self, a: AState, event) -> SimState:
+        """Concrete state for one transition: only the acting node can
+        move (its queue staged / its issue gate open); everyone else is
+        frozen by an empty mailbox + BIG_DELAY. event=None stages the
+        whole state verbatim (predicate evaluation)."""
+        cfg = self.cfg
+        N, Q = cfg.num_nodes, cfg.queue_capacity
+        kind, actor = event if event is not None else (None, None)
+
+        mb_pack = np.zeros((7, N, Q), np.int32)
+        mb_pack[MB_TYPE] = int(Msg.NONE)
+        mb_count = np.zeros((N,), np.int32)
+        stage_queues = range(N) if kind is None else \
+            ([actor] if kind == "msg" else [])
+        for r in stage_queues:
+            for i, msg in enumerate(a.queues[r]):
+                mb_pack[:6, r, i] = msg[:6]
+                mb_pack[MB_BV0, r, i] = np.uint32(msg[6]).view(np.int32)
+            mb_count[r] = len(a.queues[r])
+
+        delay = np.full((N,), BIG_DELAY, np.int32)
+        if kind == "instr":
+            delay[actor] = 0
+
+        waiting = np.asarray(a.waiting, bool)
+        op, addr, val, count = self._instr_arrays
+        z32 = np.zeros((), np.int32)
+        return SimState(
+            cache_addr=np.asarray(a.cache_addr, np.int32),
+            cache_val=np.asarray(a.cache_val, np.int32),
+            cache_state=np.asarray(a.cache_state, np.int32),
+            memory=np.asarray(a.memory, np.int32),
+            dir_state=np.asarray(a.dir_state, np.int32),
+            dir_bitvec=np.asarray(a.dir_bitvec, np.uint32)[..., None],
+            instr_op=op, instr_addr=addr, instr_val=val, instr_count=count,
+            instr_idx=np.asarray(a.instr_idx, np.int32),
+            cur_op=np.asarray(a.cur_op, np.int32),
+            cur_addr=np.asarray(a.cur_addr, np.int32),
+            cur_val=np.asarray(a.cur_val, np.int32),
+            waiting=waiting,
+            waiting_since=np.where(waiting, 0, -1).astype(np.int32),
+            mb_pack=mb_pack,
+            mb_head=np.zeros((N,), np.int32),
+            mb_count=mb_count,
+            issue_delay=delay,
+            issue_period=np.ones((N,), np.int32),
+            arb_rank=np.arange(N, dtype=np.int32),
+            order_rank=np.zeros((N, 0), np.int32),
+            fault_key=self._fault_key,
+            cycle=z32,
+            metrics=Metrics(
+                cycles=z32, instrs_retired=z32, read_hits=z32,
+                write_hits=z32, read_misses=z32, write_misses=z32,
+                upgrades=z32, msgs_processed=np.zeros((13,), np.int32),
+                msgs_dropped=z32, msgs_injected_dropped=z32,
+                invalidations=z32, evictions=z32),
+        )
+
+    def _read_back(self, a: AState, event, res, k):
+        """(next AState, dropped, overflowed) from oracle output row k."""
+        cfg = self.cfg
+        N, Q = cfg.num_nodes, cfg.queue_capacity
+        kind, actor = event
+        queues, overflow = [], False
+        for r in range(N):
+            cnt = int(res.mb_count[k, r])
+            head = int(res.mb_head[k, r])
+            ring = []
+            for i in range(cnt):
+                slot = (head + i) % Q
+                f = res.mb_pack[k, :, r, slot]
+                ring.append((int(f[0]), int(f[1]), int(f[2]), int(f[3]),
+                             int(f[4]), int(f[5]),
+                             int(np.int32(f[MB_BV0]).view(np.uint32))))
+            if kind == "msg" and r == actor:
+                # staged ring = the full abstract queue; what remains in
+                # it (plus self-sends) IS the next queue
+                q = tuple(ring)
+            else:
+                # staged empty: ring holds only this step's deliveries
+                q = a.queues[r] + tuple(ring)
+            if len(q) > Q:
+                overflow, q = True, q[:Q]
+            queues.append(q)
+        new = AState(
+            cache_addr=_t2(res.cache_addr[k]),
+            cache_val=_t2(res.cache_val[k]),
+            cache_state=_t2(res.cache_state[k]),
+            memory=_t2(res.memory[k]),
+            dir_state=_t2(res.dir_state[k]),
+            dir_bitvec=_t2(res.dir_bitvec[k][..., 0]),
+            instr_idx=_t1(res.instr_idx[k]),
+            waiting=tuple(bool(x) for x in np.asarray(res.waiting[k])),
+            cur_op=_t1(res.cur_op[k]),
+            cur_addr=_t1(res.cur_addr[k]),
+            cur_val=_t1(res.cur_val[k]),
+            queues=tuple(queues))
+        return new, int(res.metrics.msgs_dropped[k]), overflow
+
+    def _initial(self) -> AState:
+        st = jax.device_get(
+            init_state(self.cfg, traces=[list(p) for p in
+                                         self.scope.programs]))
+        return AState(
+            cache_addr=_t2(st.cache_addr), cache_val=_t2(st.cache_val),
+            cache_state=_t2(st.cache_state), memory=_t2(st.memory),
+            dir_state=_t2(st.dir_state),
+            dir_bitvec=_t2(st.dir_bitvec[..., 0]),
+            instr_idx=_t1(st.instr_idx),
+            waiting=tuple(bool(x) for x in np.asarray(st.waiting)),
+            cur_op=_t1(st.cur_op), cur_addr=_t1(st.cur_addr),
+            cur_val=_t1(st.cur_val),
+            queues=tuple(() for _ in range(self.cfg.num_nodes)))
+
+    def _batched(self, staged: list):
+        pad = _BATCH - len(staged)
+        staged = staged + [staged[0]] * pad
+        return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *staged)
+
+    # -- message/pair coverage --------------------------------------------
+
+    def _pair_key(self, a: AState, actor: int):
+        """(msg type, home/remote, cache-line state, tag match, dir
+        state at receiver) of the head message `actor` is about to
+        process — the coverage cell of the handler matrix."""
+        cfg = self.cfg
+        t, _, addr = a.queues[actor][0][:3]
+        at_home = codec.home_node(cfg, addr) == actor
+        cidx = codec.cache_index(cfg, addr)
+        block = codec.block_index(cfg, addr)
+        return (Msg(t).name,
+                "home" if at_home else "remote",
+                CACHE_STATE_NAMES[a.cache_state[actor][cidx]],
+                a.cache_addr[actor][cidx] == addr,
+                DIR_STATE_NAMES[a.dir_state[actor][block]]
+                if at_home else "-")
+
+    @staticmethod
+    def _pair_str(pair) -> str:
+        t, loc, cs, tag, ds = pair
+        tagtxt = "" if tag else " tag-miss"
+        dirtxt = f" dir={ds}" if ds != "-" else ""
+        return f"{t}@{loc} cache={cs}{tagtxt}{dirtxt}"
+
+    @staticmethod
+    def _sanctioned_noop(pair) -> str | None:
+        t, _, _, tag, _ = pair
+        if t == "INV" and not tag:
+            return ("INV on a tag mismatch is the reference's sanctioned "
+                    "no-op (assignment.c:389-399): the targeted line was "
+                    "already replaced or never filled")
+        return None
+
+    # -- rendering ---------------------------------------------------------
+
+    def _render_event(self, src: AState, ev) -> str:
+        kind, n = ev
+        if kind == "instr":
+            op, addr, val = self.scope.programs[n][src.instr_idx[n] + 1]
+            w = Op(op) == Op.WRITE
+            return (f"node{n} {'W' if w else 'R'} 0x{addr:02x}"
+                    + (f"={val}" if w else ""))
+        t, sender, addr, value, second, _, bv = src.queues[n][0]
+        extra = f" bv={bv:b}" if bv else ""
+        return (f"node{n} <- {Msg(t).name} from node{sender} "
+                f"0x{addr:02x} val={value} second={second}{extra}")
+
+    def render_state(self, a: AState) -> list:
+        cfg, lines = self.cfg, []
+        for n in range(cfg.num_nodes):
+            cache = " ".join(
+                f"[0x{a.cache_addr[n][c]:02x} v={a.cache_val[n][c]} "
+                f"{CACHE_STATE_NAMES[a.cache_state[n][c]]}]"
+                for c in range(cfg.cache_size))
+            d = " ".join(
+                f"{DIR_STATE_NAMES[a.dir_state[n][m]]}"
+                f":{a.dir_bitvec[n][m]:b}" for m in range(cfg.mem_size))
+            q = ", ".join(Msg(m[0]).name for m in a.queues[n]) or "-"
+            flag = " WAITING" if a.waiting[n] else ""
+            lines.append(f"node{n}: cache {cache} mem={list(a.memory[n])} "
+                         f"dir {d} q=[{q}]{flag}")
+        return lines
+
+    # -- the run ------------------------------------------------------------
+
+    def run(self) -> dict:
+        scope, cfg = self.scope, self.cfg
+        a0 = self._initial()
+        ids = {a0: 0}
+        states = [a0]
+        parent = [None]          # per id: (pred_id, event) or None
+        adj = [[]]               # per id: list of (event, dst_id)
+        terminals = []
+        engaged_pairs = {}       # pair -> [count, first_state_id]
+        noop_pairs = {}
+        violations = []
+        n_msg = n_instr = 0
+
+        frontier = [0]
+        while frontier:
+            jobs = []
+            for sid in frontier:
+                evs = enabled_events(scope, states[sid])
+                if not evs:
+                    terminals.append(sid)
+                jobs.extend((sid, ev) for ev in evs)
+            nxt = []
+            for start in range(0, len(jobs), _BATCH):
+                chunk = jobs[start:start + _BATCH]
+                batch = self._batched(
+                    [self._stage(states[sid], ev) for sid, ev in chunk])
+                res, engaged = self._oracle(batch)
+                res = jax.device_get(res)
+                engaged = np.asarray(engaged)
+                for j, (sid, ev) in enumerate(chunk):
+                    new_a, dropped, ovf = self._read_back(
+                        states[sid], ev, res, j)
+                    if dropped or ovf:
+                        violations.append({
+                            "check": "scope_overflow",
+                            "name": "scope_overflow",
+                            "detail": "mailbox capacity exceeded inside "
+                                      "the scope — enlarge queue_capacity",
+                            "state": sid,
+                            "path": self.path_to(parent, states, sid)})
+                    if ev[0] == "msg":
+                        n_msg += 1
+                        pair = self._pair_key(states[sid], ev[1])
+                        bucket = engaged_pairs if bool(engaged[j, ev[1]]) \
+                            else noop_pairs
+                        if pair not in bucket:
+                            bucket[pair] = [0, sid]
+                        bucket[pair][0] += 1
+                    else:
+                        n_instr += 1
+                    nid = ids.get(new_a)
+                    if nid is None:
+                        nid = len(states)
+                        ids[new_a] = nid
+                        states.append(new_a)
+                        parent.append((sid, ev))
+                        adj.append([])
+                        nxt.append(nid)
+                        if nid >= self.max_states:
+                            raise ScopeTooLarge(
+                                f"scope {scope.name}: > {self.max_states} "
+                                "states")
+                    adj[sid].append((ev, nid))
+            frontier = nxt
+
+        # ---- progress: deadlock + livelock -------------------------------
+        quiescent_terms, deadlocks = [], []
+        for sid in terminals:
+            if any(states[sid].waiting):
+                deadlocks.append(sid)
+            else:
+                quiescent_terms.append(sid)
+        for sid in deadlocks:
+            violations.append({
+                "check": "deadlock",
+                "name": "deadlock",
+                "detail": "terminal state with a blocked node (a reply "
+                          "was lost or never clears `waiting`)",
+                "state": sid,
+                "path": self.path_to(parent, states, sid),
+                "state_render": self.render_state(states[sid])})
+
+        can_finish = self._backward_reach(adj, terminals)
+        stuck = [sid for sid in range(len(states)) if not can_finish[sid]]
+        if stuck:
+            sid = stuck[0]
+            violations.append({
+                "check": "livelock",
+                "name": "livelock",
+                "detail": f"{len(stuck)} reachable states cannot reach "
+                          "any terminal state (message cycle)",
+                "state": sid,
+                "path": self.path_to(parent, states, sid),
+                "state_render": self.render_state(states[sid])})
+
+        # ---- handler coverage --------------------------------------------
+        sanctioned_noops = []
+        for pair in sorted(noop_pairs):
+            why = self._sanctioned_noop(pair)
+            count, sid = noop_pairs[pair]
+            if why is not None:
+                sanctioned_noops.append({
+                    "pair": self._pair_str(pair), "count": count,
+                    "rationale": why})
+            else:
+                violations.append({
+                    "check": "unhandled_pair",
+                    "name": "unhandled_pair",
+                    "detail": f"message silently ignored: "
+                              f"{self._pair_str(pair)} "
+                              f"({count} occurrences)",
+                    "state": sid,
+                    "path": self.path_to(parent, states, sid),
+                    "state_render": self.render_state(states[sid])})
+
+        # ---- engine-tier invariants on EVERY reachable state -------------
+        step_names = list(invariants.step_violations(
+            cfg, init_state(cfg)).keys())
+        step_hits = {}
+        for start in range(0, len(states), _BATCH):
+            chunk = states[start:start + _BATCH]
+            batch = self._batched(
+                [self._stage(a, None) for a in chunk])
+            masks = jax.device_get(self._step_preds(batch))
+            for name in step_names:
+                bad = np.asarray(masks[name]).reshape(_BATCH, -1).any(axis=1)
+                for j in range(len(chunk)):
+                    if bad[j] and name not in step_hits:
+                        step_hits[name] = start + j
+        for name in sorted(step_hits):
+            sid = step_hits[name]
+            violations.append({
+                "check": "step_invariant", "name": name, "state": sid,
+                "detail": f"engine-tier invariant `{name}` violated on a "
+                          "reachable state",
+                "path": self.path_to(parent, states, sid),
+                "state_render": self.render_state(states[sid])})
+
+        # ---- coherence tier at quiescent terminals -----------------------
+        quirks, quiet_hits = {}, {}
+        for start in range(0, len(quiescent_terms), _BATCH):
+            chunk = quiescent_terms[start:start + _BATCH]
+            batch = self._batched(
+                [self._stage(states[sid], None) for sid in chunk])
+            masks = jax.device_get(self._quiet_preds(batch))
+            for name, mask in masks.items():
+                bad = np.asarray(mask).reshape(_BATCH, -1).any(axis=1)
+                for j, sid in enumerate(chunk):
+                    if not bad[j]:
+                        continue
+                    if name in QUIRK_ALLOWLIST:
+                        if name not in quirks:
+                            quirks[name] = [0, sid]
+                        quirks[name][0] += 1
+                    elif name not in quiet_hits:
+                        quiet_hits[name] = sid
+        for name in sorted(quiet_hits):
+            sid = quiet_hits[name]
+            violations.append({
+                "check": "coherence", "name": name, "state": sid,
+                "detail": f"coherence contract `{name}` violated at a "
+                          "quiescent state (not a sanctioned quirk)",
+                "path": self.path_to(parent, states, sid),
+                "state_render": self.render_state(states[sid])})
+
+        violations.sort(key=lambda v: (v["check"], v.get("name", ""),
+                                       v["state"]))
+        report = {
+            "scope": scope.describe(),
+            "stats": {
+                "states": len(states),
+                "transitions": n_msg + n_instr,
+                "msg_events": n_msg,
+                "instr_events": n_instr,
+                "terminal_states": len(terminals),
+                "quiescent_states": len(quiescent_terms),
+                "deadlocked_states": len(deadlocks),
+            },
+            "coverage": {
+                "engaged_pairs": sorted(
+                    self._pair_str(p) for p in engaged_pairs),
+                "sanctioned_noops": sanctioned_noops,
+            },
+            "quirks": [
+                {"name": name, "states": quirks[name][0],
+                 "rationale": QUIRK_ALLOWLIST[name],
+                 "example_state": quirks[name][1],
+                 "example_path": self.path_to(parent, states,
+                                              quirks[name][1])}
+                for name in sorted(quirks)],
+            "violations": violations,
+            "ok": not violations,
+        }
+        return report
+
+    def path_to(self, parent, states, sid) -> list:
+        """Counterexample path: rendered events from the initial state."""
+        chain = []
+        while parent[sid] is not None:
+            pid, ev = parent[sid]
+            chain.append(self._render_event(states[pid], ev))
+            sid = pid
+        return list(reversed(chain))
+
+    @staticmethod
+    def _backward_reach(adj, seeds):
+        """Which states can reach a seed (terminal) state?"""
+        n = len(adj)
+        rev = [[] for _ in range(n)]
+        for src, out in enumerate(adj):
+            for _, dst in out:
+                rev[dst].append(src)
+        seen = [False] * n
+        stack = list(seeds)
+        for s in seeds:
+            seen[s] = True
+        while stack:
+            v = stack.pop()
+            for u in rev[v]:
+                if not seen[u]:
+                    seen[u] = True
+                    stack.append(u)
+        return seen
+
+
+def check_scope(scope: Scope, message_phase=None,
+                max_states: int = 50_000) -> dict:
+    """One-call convenience: build a checker, run it, return the report."""
+    return ModelChecker(scope, message_phase=message_phase,
+                        max_states=max_states).run()

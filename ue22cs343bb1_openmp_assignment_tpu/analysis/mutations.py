@@ -1,0 +1,103 @@
+"""Seeded handler bugs: the model checker's own regression suite.
+
+Each mutation wraps the real :func:`..ops.handlers.message_phase` and
+perturbs exactly one transition effect — the classic protocol-bug
+shapes a hand-written MESI implementation gets wrong. `cache-sim
+analyze` must exit 0 on the shipped handlers and 1 under every one of
+these (tests/test_static_analysis.py); a checker that misses any of
+them is not trusted in CI.
+
+Every wrapper keeps the `message_phase` contract (updates, cand_parts,
+inv_scatter, stats) and is injected through ops/step.cycle's
+``message_phase`` hook, so the surrounding engine — merge, delivery,
+arbitration — stays the shipped code.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ue22cs343bb1_openmp_assignment_tpu import codec
+from ue22cs343bb1_openmp_assignment_tpu.ops import handlers
+from ue22cs343bb1_openmp_assignment_tpu.types import Msg
+
+
+def _is(mv, ty):
+    return mv.has_msg & (mv.type == int(ty))
+
+
+def skip_em_bitvec_clear(cfg, state, mv):
+    """EVICT_MODIFIED still sets the directory Unowned but forgets to
+    clear the owner's sharer bit (the reference clears it at
+    ``assignment.c:615-616``). Expected: `unowned_with_sharers`
+    engine-tier violation on every post-eviction state."""
+    upd, cand, inv, stats = handlers.message_phase(cfg, state, mv)
+    m, i, v = upd["dir_bv"]
+    upd = dict(upd, dir_bv=(m & ~_is(mv, Msg.EVICT_MODIFIED), i, v))
+    return upd, cand, inv, stats
+
+
+def upgrade_keeps_other_sharers(cfg, state, mv):
+    """UPGRADE grants EM ownership without shrinking the sharer set to
+    the new owner (the reference overwrites the bitvector with the
+    requester's bit, ``assignment.c:346-348``). Expected:
+    `em_not_single_owner` engine-tier violation."""
+    upd, cand, inv, stats = handlers.message_phase(cfg, state, mv)
+    rows = jnp.arange(cfg.num_nodes, dtype=jnp.int32)
+    dirbv = state.dir_bitvec[rows, codec.block_index(cfg, mv.addr)]
+    m, i, v = upd["dir_bv"]
+    keep = _is(mv, Msg.UPGRADE)[:, None]
+    upd = dict(upd, dir_bv=(m, i, jnp.where(keep, v | dirbv, v)))
+    return upd, cand, inv, stats
+
+
+def no_wait_clear_on_reply_rd(cfg, state, mv):
+    """REPLY_RD delivers the fill but never unblocks the requester
+    (the reference clears ``waitingForReply`` in every reply handler,
+    ``assignment.c:384``). Expected: `deadlock` — a terminal state
+    with the reader still blocked. Must run on the read-only scope
+    ``2n1a_r``: in the write scopes quirk 2 (FLUSH/FLUSH_INVACK clear
+    `waiting` unconditionally) rescues the stranded reader on every
+    interleaving and masks the bug."""
+    upd, cand, inv, stats = handlers.message_phase(cfg, state, mv)
+    upd = dict(upd,
+               wait_clear=upd["wait_clear"] & ~_is(mv, Msg.REPLY_RD))
+    return upd, cand, inv, stats
+
+
+def drop_evict_modified(cfg, state, mv):
+    """EVICT_MODIFIED is dequeued and then ignored entirely — no
+    memory write-back, no directory update (the reference's handler at
+    ``assignment.c:596-616``). Expected: `unhandled_pair` from the
+    handler-engagement probe."""
+    upd, cand, inv, stats = handlers.message_phase(cfg, state, mv)
+    dead = _is(mv, Msg.EVICT_MODIFIED)
+    keep = ~dead
+    cs_m, cs_v = upd["cache_state"]
+    fl_m, fl_v = upd["cache_addr"]
+    cv_m, cv_v = upd["cache_val"]
+    mm, mi, mval = upd["mem"]
+    dm, di, dv = upd["dir_state"]
+    bm, bi, bv = upd["dir_bv"]
+    upd = dict(upd,
+               cache_state=(cs_m & keep, cs_v),
+               cache_addr=(fl_m & keep, fl_v),
+               cache_val=(cv_m & keep, cv_v),
+               mem=(mm & keep, mi, mval),
+               dir_state=(dm & keep, di, dv),
+               dir_bv=(bm & keep, bi, bv),
+               wait_clear=upd["wait_clear"] & keep)
+    return upd, cand, inv, stats
+
+
+# name -> (wrapper, scope that exposes it, finding the checker must raise)
+MUTATIONS = {
+    "skip_em_bitvec_clear": (skip_em_bitvec_clear, "2n2a",
+                             "unowned_with_sharers"),
+    "upgrade_keeps_other_sharers": (upgrade_keeps_other_sharers, "2n1a",
+                                    "em_not_single_owner"),
+    "no_wait_clear_on_reply_rd": (no_wait_clear_on_reply_rd, "2n1a_r",
+                                  "deadlock"),
+    "drop_evict_modified": (drop_evict_modified, "2n2a",
+                            "unhandled_pair"),
+}

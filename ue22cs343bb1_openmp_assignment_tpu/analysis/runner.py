@@ -1,0 +1,151 @@
+"""`cache-sim analyze` — the static-analysis gate (host-side CLI).
+
+Runs the protocol model checker over the builtin small scopes and the
+JAX trace linter over the traced packages, prints a human report that
+keeps reference-sanctioned quirks (`~`) visually distinct from genuine
+violations (`!`), optionally writes the full JSON report, and exits
+nonzero iff anything genuinely failed. This is the CI entry point
+(scripts/check.sh); `python -m ue22cs343bb1_openmp_assignment_tpu.analysis`
+is the same thing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cache-sim analyze",
+        description="Statically verify the coherence engine: small-scope "
+                    "protocol model checking + JAX trace lint.")
+    p.add_argument("--scopes", default=None,
+                   help="comma-separated scope names (default: all "
+                        "builtin scopes)")
+    p.add_argument("--list-scopes", action="store_true",
+                   help="print the builtin scopes and exit")
+    p.add_argument("--skip-model-check", action="store_true")
+    p.add_argument("--skip-lint", action="store_true")
+    p.add_argument("--mutation", default=None,
+                   help="run the model checker with this seeded handler "
+                        "bug from analysis.mutations (the checker must "
+                        "fail — its own regression test)")
+    p.add_argument("--max-states", type=int, default=50_000,
+                   help="state-count guard per scope (default 50000)")
+    p.add_argument("--json", dest="json_path", default=None,
+                   help="write the full JSON report here")
+    p.add_argument("--lint-paths", nargs="*", default=None,
+                   help="lint these files/dirs instead of the default "
+                        "ops/ parallel/ models/")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="only the verdict line")
+    return p
+
+
+def _print(quiet: bool, *a) -> None:
+    if not quiet:
+        print(*a)
+
+
+def run_model_check(scope_names, mutation, max_states, quiet) -> dict:
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import model_check
+    scopes = model_check.builtin_scopes()
+    names = list(scopes) if scope_names is None else [
+        s.strip() for s in scope_names.split(",") if s.strip()]
+    unknown = [n for n in names if n not in scopes]
+    if unknown:
+        raise SystemExit(f"unknown scope(s): {', '.join(unknown)} "
+                         f"(have: {', '.join(scopes)})")
+
+    mp = None
+    if mutation is not None:
+        from ue22cs343bb1_openmp_assignment_tpu.analysis import mutations
+        if mutation not in mutations.MUTATIONS:
+            raise SystemExit(
+                f"unknown mutation `{mutation}` "
+                f"(have: {', '.join(mutations.MUTATIONS)})")
+        fn, mscope, expected = mutations.MUTATIONS[mutation]
+        mp = fn
+        if scope_names is None:
+            names = [mscope]
+        _print(quiet, f"== seeded mutation `{mutation}` on scope "
+                      f"{mscope} (expected finding: {expected})")
+
+    out = {}
+    for name in names:
+        rep = model_check.check_scope(scopes[name], message_phase=mp,
+                                      max_states=max_states)
+        out[name] = rep
+        st = rep["stats"]
+        verdict = "ok" if rep["ok"] else "FAIL"
+        _print(quiet,
+               f"== scope {name}: {verdict}  "
+               f"[{st['states']} states, {st['transitions']} transitions, "
+               f"{st['quiescent_states']} quiescent, "
+               f"{st['deadlocked_states']} deadlocked]")
+        for q in rep["quirks"]:
+            _print(quiet, f"  ~ {q['name']} ({q['states']} states) — "
+                          f"sanctioned: {q['rationale']}")
+        for n in rep["coverage"]["sanctioned_noops"]:
+            _print(quiet, f"  ~ no-op {n['pair']} ({n['count']}x) — "
+                          f"sanctioned: {n['rationale']}")
+        for v in rep["violations"]:
+            _print(quiet, f"  ! {v['check']}"
+                          f"{'/' + v['name'] if v.get('name') and v['name'] != v['check'] else ''}"
+                          f": {v['detail']}")
+            for step in v.get("path", [])[-6:]:
+                _print(quiet, f"      > {step}")
+            for line in v.get("state_render", []):
+                _print(quiet, f"      | {line}")
+    return out
+
+
+def run_lint(paths, quiet) -> dict:
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import lint_trace
+    findings = lint_trace.lint_paths(paths)
+    n_files = len({f.file for f in findings})
+    if findings:
+        _print(quiet, f"== lint: FAIL ({len(findings)} findings in "
+                      f"{n_files} files)")
+        for f in findings:
+            _print(quiet, f"  ! {f.render()}")
+    else:
+        _print(quiet, "== lint: ok (0 findings)")
+    return {"ok": not findings,
+            "findings": [f.as_dict() for f in findings]}
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_scopes:
+        from ue22cs343bb1_openmp_assignment_tpu.analysis import model_check
+        for name, scope in model_check.builtin_scopes().items():
+            d = scope.describe()
+            print(f"{name}: {d['num_nodes']} nodes, programs "
+                  f"{d['programs']}")
+        return 0
+
+    report = {"model_check": {}, "lint": None}
+    ok = True
+    if not args.skip_model_check:
+        report["model_check"] = run_model_check(
+            args.scopes, args.mutation, args.max_states, args.quiet)
+        ok &= all(r["ok"] for r in report["model_check"].values())
+    if not args.skip_lint:
+        report["lint"] = run_lint(args.lint_paths, args.quiet)
+        ok &= report["lint"]["ok"]
+    report["ok"] = ok
+
+    if args.json_path:
+        with open(args.json_path, "w") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+        _print(args.quiet, f"report written to {args.json_path}")
+
+    print("analyze:", "PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

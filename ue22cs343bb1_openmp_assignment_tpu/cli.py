@@ -24,7 +24,9 @@ import numpy as np
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="cache-sim",
-        description="TPU-native directory/MESI coherence simulator")
+        description="TPU-native directory/MESI coherence simulator "
+                    "(`cache-sim analyze` runs the static-analysis gate: "
+                    "protocol model checker + JAX trace lint)")
     p.add_argument("test_dir", nargs="?", default=None,
                    help="test directory name (reference-compat positional)")
     p.add_argument("--tests-root", default="tests",
@@ -578,7 +580,13 @@ def _main_omp(args) -> int:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    raw = sys.argv[1:] if argv is None else list(argv)
+    if raw[:1] == ["analyze"]:
+        # the static-analysis gate has its own parser (and no need for
+        # the simulator's positional workload argument)
+        from ue22cs343bb1_openmp_assignment_tpu.analysis import runner
+        return runner.main(raw[1:])
+    args = build_parser().parse_args(raw)
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")

@@ -80,7 +80,8 @@ class CoherenceSystem:
 
     def run_cycles_traced(self, n: int):
         """run_cycles + the structured event record; returns
-        (system, events) with events a dict of [n, N] host arrays."""
+        (system, events) with events a dict of [n, N] host arrays
+        (host-side driver: events land in numpy by design)."""
         import numpy as np
 
         from ue22cs343bb1_openmp_assignment_tpu.ops import step
@@ -89,7 +90,8 @@ class CoherenceSystem:
                 {k: np.asarray(v) for k, v in ev.items()})
 
     def run_traced(self, max_cycles: int = 100_000, chunk: int = 64):
-        """Run to quiescence collecting the structured event log.
+        """Run to quiescence collecting the structured event log
+        (host-side driver: chunked dispatch, events land in numpy).
 
         Returns (system, events) where events is a dict of
         [cycles, N] host arrays (see ops.step.run_cycles_traced /

@@ -26,6 +26,14 @@ _CXX = os.environ.get("CXX", "g++")
 # their own cached library instead of silently reusing the default one
 _CXXFLAGS = shlex.split(os.environ.get(
     "CXXFLAGS", "-O2 -std=c++17 -fPIC -Wall -Wextra"))
+# COHERENCE_NATIVE_SANITIZE=1 appends the ASan+UBSan flags (keep in
+# sync with the Makefile's SANITIZE=1 block). The host process must
+# LD_PRELOAD libasan for the sanitized .so to load — see the Makefile
+# note and the slow-marked differential test.
+_SANITIZE_FLAGS = ["-fsanitize=address,undefined",
+                   "-fno-omit-frame-pointer", "-g"]
+if os.environ.get("COHERENCE_NATIVE_SANITIZE") == "1":
+    _CXXFLAGS = _CXXFLAGS + _SANITIZE_FLAGS
 _lock = threading.Lock()
 _lib = None
 

@@ -63,7 +63,7 @@ def message_phase(cfg: SystemConfig, state: SimState, mv: MsgView):
     cl_val = state.cache_val[rows, p_cidx]
     cl_state = state.cache_state[rows, p_cidx]
 
-    def m(ty):
+    def m(ty: int):
         return has & (t == int(ty))
 
     is_rr = m(Msg.READ_REQUEST)

@@ -53,13 +53,15 @@ def _count(pred) -> jnp.ndarray:
     return jnp.sum(pred).astype(jnp.int32)
 
 
-def step_violations(cfg: SystemConfig, state: SimState) -> dict:
-    """Invariants that must hold after every cycle.
+def step_predicates(cfg: SystemConfig, state: SimState) -> dict:
+    """Engine-tier predicates as violation *masks* (True = violated).
 
-    The directory-side trio mirrors what the reference maintains
-    atomically inside each handler (it never leaves a handler with EM
-    and ≠1 sharer bits: ``assignment.c:228-231,346-348,455-457,
-    570-583,615-616``).
+    The single source of the invariant definitions: the dynamic checker
+    (:func:`step_violations`) reduces these to counts, the static model
+    checker (analysis/model_check.py) evaluates them on every explored
+    state and uses the masks to locate offending (node, block) cells.
+    Mask shapes vary per predicate ([N, M], [N, C] or [N]); only
+    truthiness and position matter.
     """
     pc = popcount(state.dir_bitvec)                       # [N, M]
     is_em = state.dir_state == int(DirState.EM)
@@ -68,43 +70,46 @@ def step_violations(cfg: SystemConfig, state: SimState) -> dict:
 
     return {
         # directory ⟷ sharer-bitvector consistency
-        "em_not_single_owner": _count(is_em & (pc != 1)),
-        "shared_without_sharers": _count(is_s & (pc < 1)),
-        "unowned_with_sharers": _count(is_u & (pc != 0)),
+        "em_not_single_owner": is_em & (pc != 1),
+        "shared_without_sharers": is_s & (pc < 1),
+        "unowned_with_sharers": is_u & (pc != 0),
         # enum ranges (a scatter writing garbage shows up here first)
-        "dir_state_out_of_range": _count(
-            (state.dir_state < 0) | (state.dir_state > int(DirState.U))),
-        "cache_state_out_of_range": _count(
+        "dir_state_out_of_range":
+            (state.dir_state < 0) | (state.dir_state > int(DirState.U)),
+        "cache_state_out_of_range":
             (state.cache_state < 0)
-            | (state.cache_state > int(CacheState.INVALID))),
+            | (state.cache_state > int(CacheState.INVALID)),
         # ring occupancy within capacity, head within ring
-        "mailbox_count_oob": _count(
-            (state.mb_count < 0) | (state.mb_count > cfg.queue_capacity)),
-        "mailbox_head_oob": _count(
-            (state.mb_head < 0) | (state.mb_head >= cfg.queue_capacity)),
+        "mailbox_count_oob":
+            (state.mb_count < 0) | (state.mb_count > cfg.queue_capacity),
+        "mailbox_head_oob":
+            (state.mb_head < 0) | (state.mb_head >= cfg.queue_capacity),
         # a node past its trace end must not be mid-request
-        "waiting_past_trace_end": _count(
-            state.waiting & (state.instr_idx >= state.instr_count)),
+        "waiting_past_trace_end":
+            state.waiting & (state.instr_idx >= state.instr_count),
         # byte-valued payloads stay bytes (values are &0xFF at load,
         # assignment.c:840-845; a handler that forgets the mask drifts)
-        "memory_not_byte": _count(
-            (state.memory < 0) | (state.memory > 0xFF)),
+        "memory_not_byte": (state.memory < 0) | (state.memory > 0xFF),
     }
 
 
-def quiescent_violations(cfg: SystemConfig, state: SimState) -> dict:
-    """The full coherence contract, valid once quiescent().
+def step_violations(cfg: SystemConfig, state: SimState) -> dict:
+    """Invariants that must hold after every cycle, as counts.
 
-    Cross-checks every cached line against its home directory — the
-    single-writer property the whole DASH/MESI protocol exists to
-    enforce (``README.md:14-23``):
+    The directory-side trio mirrors what the reference maintains
+    atomically inside each handler (it never leaves a handler with EM
+    and ≠1 sharer bits: ``assignment.c:228-231,346-348,455-457,
+    570-583,615-616``).
+    """
+    return {k: _count(v) for k, v in step_predicates(cfg, state).items()}
 
-    * a valid line's bit is set in its home directory entry,
-    * MODIFIED/EXCLUSIVE lines coincide with directory EM,
-    * a block has at most one M/E copy system-wide, and no other valid
-      copies besides it,
-    * clean lines (E, S) agree with home memory (S lines were written
-      back via FLUSH before demotion, ``assignment.c:301-308``).
+
+def quiescent_predicates(cfg: SystemConfig, state: SimState) -> dict:
+    """Coherence-tier predicates as violation masks (True = violated).
+
+    Shared definition for the dynamic count reduction
+    (:func:`quiescent_violations`) and the static model checker; see
+    that function's docstring for the contract.
     """
     N, C, M = cfg.num_nodes, cfg.cache_size, cfg.mem_size
     rows = jnp.arange(N, dtype=jnp.int32)[:, None]        # [N, 1]
@@ -129,20 +134,37 @@ def quiescent_violations(cfg: SystemConfig, state: SimState) -> dict:
     mem_val = state.memory[h, b]
 
     return {
-        "valid_line_unknown_to_home": _count(valid & ~my_bit),
-        "exclusive_line_dir_not_em": _count(
-            (is_m | is_e) & (dstate != int(DirState.EM))),
-        "shared_line_dir_unowned": _count(
-            is_s & (dstate == int(DirState.U))),
-        "multiple_owners": _count(owners > 1),
-        "owner_with_other_copies": _count((owners == 1) & (copies > 1)),
-        "clean_line_stale_value": _count(
-            (is_e | is_s) & (state.cache_val != mem_val)),
+        "valid_line_unknown_to_home": valid & ~my_bit,
+        "exclusive_line_dir_not_em":
+            (is_m | is_e) & (dstate != int(DirState.EM)),
+        "shared_line_dir_unowned": is_s & (dstate == int(DirState.U)),
+        "multiple_owners": owners > 1,
+        "owner_with_other_copies": (owners == 1) & (copies > 1),
+        "clean_line_stale_value":
+            (is_e | is_s) & (state.cache_val != mem_val),
         # every directory sharer bit corresponds to a real cached copy:
         # popcount over the directory == scatter-count of valid lines
         # pointing at it (no phantom sharers at quiescence)
-        "phantom_sharers": _count(popcount(state.dir_bitvec) != copies),
+        "phantom_sharers": popcount(state.dir_bitvec) != copies,
     }
+
+
+def quiescent_violations(cfg: SystemConfig, state: SimState) -> dict:
+    """The full coherence contract, valid once quiescent(), as counts.
+
+    Cross-checks every cached line against its home directory — the
+    single-writer property the whole DASH/MESI protocol exists to
+    enforce (``README.md:14-23``):
+
+    * a valid line's bit is set in its home directory entry,
+    * MODIFIED/EXCLUSIVE lines coincide with directory EM,
+    * a block has at most one M/E copy system-wide, and no other valid
+      copies besides it,
+    * clean lines (E, S) agree with home memory (S lines were written
+      back via FLUSH before demotion, ``assignment.c:301-308``).
+    """
+    return {k: _count(v)
+            for k, v in quiescent_predicates(cfg, state).items()}
 
 
 def all_violations(cfg: SystemConfig, state: SimState,

@@ -84,7 +84,7 @@ def dequeue(cfg: SystemConfig, state) -> tuple:
     an empty queue, see ops.step). One row gather serves every field.
     """
     N = cfg.num_nodes
-    rows = jnp.arange(N)
+    rows = jnp.arange(N, dtype=jnp.int32)
     has = state.mb_count > 0
     h = state.mb_head
     safe_h = jnp.where(has, h, 0)

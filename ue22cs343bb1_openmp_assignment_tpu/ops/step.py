@@ -37,7 +37,7 @@ from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, Msg
 
 
 def cycle(cfg: SystemConfig, state: SimState,
-          with_events: bool = False):
+          with_events: bool = False, message_phase=None):
     """Advance the whole machine by one cycle.
 
     Cross-sender arbitration order for this cycle's deliveries comes from
@@ -49,14 +49,22 @@ def cycle(cfg: SystemConfig, state: SimState,
     the reference's ``DEBUG_INSTR``/``DEBUG_MSG`` printf tracing,
     ``assignment.c:649-652,179-182``) as a dict of [N] arrays; the
     return becomes ``(state, events)``. The default path pays nothing.
+
+    ``message_phase`` overrides the handler-phase function (same
+    signature and return contract as ``handlers.message_phase``). The
+    static model checker uses this to drive *mutated* handlers through
+    the unmodified engine (analysis/mutations.py); production callers
+    leave it None.
     """
+    if message_phase is None:
+        message_phase = handlers.message_phase
     N = cfg.num_nodes
     rows = jnp.arange(N, dtype=jnp.int32)
     arb_rank = state.arb_rank
 
     # ---- phase 1: message handlers ---------------------------------------
     mv, new_head, new_count = mailbox.dequeue(cfg, state)
-    m_upd, m_cand, inv_scatter, m_stats = handlers.message_phase(
+    m_upd, m_cand, inv_scatter, m_stats = message_phase(
         cfg, state, mv)
 
     # ---- phase 2: instruction frontend (only message-idle, unblocked) ----

@@ -223,7 +223,8 @@ def to_sim_arrays(cfg: SystemConfig, st: SyncState):
 
 def continue_with_traces(cfg: SystemConfig, st: SyncState, traces=None,
                          instr_arrays=None) -> SyncState:
-    """Stream the next trace phase into a retired machine.
+    """Stream the next trace phase into a retired machine (host-side
+    phase boundary — blocks on the quiescence flag by design).
 
     Transactional-engine twin of state.continue_with_traces: caches,
     the directory table and metrics persist; the instruction stream

@@ -46,7 +46,8 @@ def make_multihost_mesh(num_hosts: Optional[int] = None,
     slice call ``jax.distributed.initialize()`` first and pass nothing —
     the process/host structure comes from ``jax.devices()``; for
     single-process validation pass ``num_hosts`` to fold a flat device
-    list into a virtual host dimension.
+    list into a virtual host dimension. Host-side setup code (device
+    objects, not traced values).
     """
     devices = list(devices if devices is not None else jax.devices())
     if num_hosts is None:

@@ -35,7 +35,11 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+
+try:                                    # jax >= 0.4.35 exports it at top level
+    from jax import shard_map
+except ImportError:                     # 0.4.x fallback (e.g. 0.4.37)
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig

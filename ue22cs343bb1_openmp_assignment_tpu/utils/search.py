@@ -16,7 +16,7 @@ more than one.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Sequence
 
 from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
 from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
