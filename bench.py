@@ -304,7 +304,10 @@ def main():
     def total_retired(st):
         return int(np.sum(np.asarray(st.metrics.instrs_retired)))
 
-    total_retired(run())              # warmup; device_get = real sync
+    from ue22cs343bb1_openmp_assignment_tpu.obs.phases import PhaseTimer
+    timer = PhaseTimer()
+    with timer.phase("warmup_compile"):
+        total_retired(run())          # warmup; device_get = real sync
 
     if args.profile:
         try:
@@ -322,8 +325,15 @@ def main():
     for _ in range(args.reps):
         t0 = time.perf_counter()
         state = run()
+        t1 = time.perf_counter()
         retired = total_retired(state)    # device_get = real sync
-        times.append(time.perf_counter() - t0)
+        t2 = time.perf_counter()
+        times.append(t2 - t0)
+        # phase split (obs.phases): dispatch returns once XLA accepts
+        # the program; the device_get is where the run actually
+        # synchronizes — PERF.md's known trap when read separately
+        timer.add("execute_dispatch", t1 - t0)
+        timer.add("device_get_sync", t2 - t1)
     times.sort()
     elapsed = times[len(times) // 2]
     value = retired / elapsed
@@ -349,6 +359,7 @@ def main():
         "quiescent": quiet,
         "elapsed_s": round(elapsed, 3),
         "rep_times_s": [round(t, 3) for t in times],
+        "phases": timer.report(),
     }
     if args.engine == "async":
         # surface the reference's silent-drop failure mode (quirk 6): a

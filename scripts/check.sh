@@ -7,14 +7,29 @@
 #
 # The analyze step is `cache-sim analyze`: the small-scope protocol
 # model checker over the builtin scopes plus the JAX trace linter over
-# ops/ parallel/ models/. It exits nonzero on any genuine violation
+# ops/ parallel/ models/ obs/. It exits nonzero on any genuine violation
 # (reference-sanctioned quirks are reported but allowlisted).
+#
+# The obs smoke step runs `cache-sim stats` on the mini fixture and
+# validates the emitted report against the cache-sim/metrics/v1 schema
+# (the golden comparison lives in tests/test_obs.py).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
 python -m ue22cs343bb1_openmp_assignment_tpu.analysis ${ANALYZE_ARGS:-}
+
+python -m ue22cs343bb1_openmp_assignment_tpu.cli stats mini \
+    --tests-root tests/fixtures --out /tmp/_obs_smoke.json
+python - <<'PY'
+import json
+from ue22cs343bb1_openmp_assignment_tpu.obs import schema
+doc = schema.validate(json.load(open("/tmp/_obs_smoke.json")))
+assert doc["engine"] == "async" and doc["instrs_retired"] > 0
+print("obs smoke: ok (schema", doc["schema"] + ",",
+      doc["instrs_retired"], "instrs)")
+PY
 
 if [[ "${1:-}" == "--analyze" ]]; then
     exit 0

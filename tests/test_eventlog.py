@@ -124,6 +124,47 @@ def test_sync_engine_trace_log_program_order(tmp_path):
     assert open(path).read().splitlines() == lines
 
 
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _run_mini_traced():
+    sys_ = CoherenceSystem.from_test_dir(os.path.join(FIXTURES, "mini"))
+    sys_, events = sys_.run_traced()
+    assert sys_.quiescent
+    return sys_, events
+
+
+def test_to_records_sorted_by_cycle_then_node():
+    """to_records emits the deterministic global interleave: primary
+    key cycle, tie-break node id (the engine's replacement for the
+    reference's OS-scheduler ordering)."""
+    _, events = _run_mini_traced()
+    recs = eventlog.to_records(events)
+    assert recs, "mini fixture produced no events"
+    keys = [(r["cycle"], r["node"]) for r in recs]
+    assert keys == sorted(keys)
+    # both kinds present and every record carries its decode fields
+    kinds = {r["kind"] for r in recs}
+    assert kinds == {"instr", "msg"}
+    for r in recs:
+        if r["kind"] == "instr":
+            assert {"op", "addr", "value"} <= set(r)
+        else:
+            assert {"sender", "type", "type_name", "addr"} <= set(r)
+
+
+def test_to_lines_byte_parity_with_fixture():
+    """Rendered instruction lines reproduce the in-repo
+    instruction_order.txt byte-for-byte (the fixture is the engine's
+    own deterministic interleave, pinned so format drift is caught)."""
+    _, events = _run_mini_traced()
+    ours = eventlog.to_lines(events)
+    with open(os.path.join(FIXTURES, "mini",
+                           "instruction_order.txt")) as f:
+        fixture = [line.rstrip("\n") for line in f]
+    assert ours == fixture
+
+
 def test_multi_txn_window_trace_log_program_order():
     """Multi-transaction windows (txn_width>1) must still emit a
     retirement log whose per-node projection is exact program order."""

@@ -1,0 +1,303 @@
+"""The obs layer: unified metrics schema, on-device telemetry,
+Perfetto export, phase timers, CLI surfaces (ISSUE 2 tentpole).
+
+Everything here runs on the in-repo mini fixture or synthetic
+workloads — no reference tree needed.
+"""
+
+import copy
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ue22cs343bb1_openmp_assignment_tpu import cli
+from ue22cs343bb1_openmp_assignment_tpu.obs import (
+    PhaseTimer, perfetto, schema, timeseries)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden")
+
+
+def run_cli(args, tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    rc = cli.main(args)
+    out, err = capsys.readouterr()
+    return rc, out, err
+
+
+def _stats(engine, tmp_path, monkeypatch, capsys, extra_args=()):
+    rc, out, _ = run_cli(
+        ["stats", "--workload", "uniform", "--cpu", "--engine", engine,
+         *extra_args], tmp_path, monkeypatch, capsys)
+    assert rc == 0
+    return json.loads(out)
+
+
+# -- schema ---------------------------------------------------------------
+
+def test_validate_accepts_all_engines(tmp_path, monkeypatch, capsys):
+    for engine in ("async", "sync", "native"):
+        doc = _stats(engine, tmp_path, monkeypatch, capsys)
+        schema.validate(doc)            # raises on violation
+        assert doc["engine"] == engine
+        assert doc["schema"] == schema.SCHEMA_ID
+
+
+def test_validate_rejects_malformed():
+    good = schema.from_sync(
+        {"rounds": 3, "instrs_retired": 5, "read_hits": 1,
+         "write_hits": 1, "read_misses": 1, "write_misses": 2,
+         "upgrades": 0, "conflicts": 0, "evictions": 0,
+         "invalidations": 0, "promotions": 0})
+    schema.validate(good)
+    for mutate, frag in [
+            (lambda d: d.pop("instrs_retired"), "missing key"),
+            (lambda d: d.update(schema="nope"), "schema must be"),
+            (lambda d: d.update(read_hits=-1), "non-negative"),
+            (lambda d: d.update(step_unit="epochs"), "step_unit"),
+            (lambda d: d.update(bogus=1), "unknown key"),
+            (lambda d: d["messages"].pop("by_type"),
+             "messages missing key")]:
+        bad = copy.deepcopy(good)
+        mutate(bad)
+        with pytest.raises(ValueError, match=frag):
+            schema.validate(bad)
+
+
+def test_by_type_must_sum_to_processed_total():
+    doc = schema.from_async(
+        {"cycles": 2, "instrs_retired": 1, "read_hits": 0,
+         "write_hits": 0, "read_misses": 1, "write_misses": 0,
+         "upgrades": 0, "msgs_processed": [1] + [0] * 12,
+         "msgs_dropped": 0, "msgs_injected_dropped": 0,
+         "invalidations": 0, "evictions": 0,
+         "lat_hist": [0] * 16, "mb_depth_peak": 1})
+    schema.validate(doc)
+    doc["messages"]["processed_total"] = 99
+    with pytest.raises(ValueError, match="does not sum"):
+        schema.validate(doc)
+
+
+def test_cross_engine_consistency(tmp_path, monkeypatch, capsys):
+    """async and native implement the same message-level semantics, so
+    the unified reports must agree on every core counter AND the cycle
+    count for a deterministic workload."""
+    a = _stats("async", tmp_path, monkeypatch, capsys)
+    n = _stats("native", tmp_path, monkeypatch, capsys)
+    for k in schema.CORE_COUNTERS:
+        assert a[k] == n[k], k
+    assert a["steps"] == n["steps"]
+    # the transactional engine retires the same instruction stream
+    s = _stats("sync", tmp_path, monkeypatch, capsys)
+    assert s["instrs_retired"] == a["instrs_retired"]
+
+
+def test_metrics_flag_unified_all_engines(tmp_path, monkeypatch, capsys):
+    """The pre-existing --metrics stderr dumps now emit the same
+    schema (satellite: one documented schema for three paths)."""
+    for engine in ("async", "sync", "native"):
+        rc, _, err = run_cli(
+            ["--workload", "uniform", "--cpu", "--engine", engine,
+             "--metrics"], tmp_path, monkeypatch, capsys)
+        assert rc == 0
+        doc = schema.validate(json.loads(err.strip().splitlines()[-1]))
+        assert doc["engine"] == engine
+        assert doc["instrs_retired"] == 128
+
+
+# -- golden stats ---------------------------------------------------------
+
+def test_stats_golden_mini(tmp_path, monkeypatch, capsys):
+    rc, out, _ = run_cli(
+        ["stats", "mini", "--tests-root", FIXTURES, "--cpu"],
+        tmp_path, monkeypatch, capsys)
+    assert rc == 0
+    golden = json.load(open(os.path.join(GOLDEN, "stats_mini.json")))
+    assert json.loads(out) == golden
+
+
+# -- telemetry ------------------------------------------------------------
+
+def _telemetry_run(num_cycles=200):
+    from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+    from ue22cs343bb1_openmp_assignment_tpu.models.system import (
+        CoherenceSystem)
+    from ue22cs343bb1_openmp_assignment_tpu.ops import step
+    cfg = SystemConfig(num_nodes=4)
+    system = CoherenceSystem.from_workload(cfg, "uniform", trace_len=64,
+                                           seed=0)
+    final, telem = step.run_cycles_telemetry(cfg, system.state,
+                                             num_cycles)
+    return cfg, system, final, telem
+
+
+def test_telemetry_sums_match_cumulative_metrics():
+    """Per-cycle deltas integrate to exactly the cumulative Metrics —
+    one capture, two views."""
+    from ue22cs343bb1_openmp_assignment_tpu.ops.step import (
+        TELEMETRY_COUNTERS)
+    _, _, final, telem = _telemetry_run()
+    m = final.metrics
+    totals = np.asarray(telem["counters"]).sum(axis=0)
+    for i, name in enumerate(TELEMETRY_COUNTERS):
+        assert totals[i] == int(getattr(m, name)), name
+    np.testing.assert_array_equal(
+        np.asarray(telem["msgs_processed"]).sum(axis=0),
+        np.asarray(m.msgs_processed))
+    np.testing.assert_array_equal(
+        np.asarray(telem["lat_hist"]).sum(axis=0),
+        np.asarray(m.lat_hist))
+    assert int(np.asarray(telem["queue_depth_max"]).max()) \
+        == int(m.mb_depth_peak)
+
+
+def test_telemetry_is_observation_only():
+    """The telemetry runner must not perturb the simulation: same
+    final machine as the plain runner."""
+    from ue22cs343bb1_openmp_assignment_tpu.ops import step
+    cfg, system, final, _ = _telemetry_run()
+    plain = step.run_cycles(cfg, system.state, 200)
+    for f in ("cache_addr", "cache_val", "cache_state", "memory",
+              "dir_state", "dir_bitvec", "cur_op", "waiting"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(plain, f)), np.asarray(getattr(final, f)),
+            err_msg=f)
+    assert int(plain.metrics.instrs_retired) \
+        == int(final.metrics.instrs_retired)
+
+
+def test_latency_histogram_counts_waits():
+    """Every completed coherence wait lands in exactly one bucket:
+    total histogram mass == number of misses that completed (each miss
+    waits once), and no mass sits beyond the max observed latency."""
+    _, _, final, _ = _telemetry_run(400)
+    m = final.metrics
+    hist = np.asarray(m.lat_hist)
+    completed = int(hist.sum())
+    # every retired instruction was a hit or a completed miss-wait
+    hits = int(m.read_hits) + int(m.write_hits)
+    assert completed == int(m.instrs_retired) - hits
+    assert completed > 0
+
+
+def test_timeseries_rendering():
+    _, _, _, telem = _telemetry_run(100)
+    series = timeseries.to_series(telem)
+    assert series["cycles"] == 100
+    assert len(series["series"]["instrs_retired"]) == 100
+    assert len(series["series"]["msgs_READ_REQUEST"]) == 100
+    summary = timeseries.summarize(telem)
+    assert summary["counter_totals"]["instrs_retired"] \
+        == sum(series["series"]["instrs_retired"])
+    assert set(summary["dir_occupancy_last"]) == {"EM", "S", "U"}
+
+
+def test_stats_timeseries_cli(tmp_path, monkeypatch, capsys):
+    ts_path = tmp_path / "series.json"
+    doc = _stats("async", tmp_path, monkeypatch, capsys,
+                 ["--timeseries", "--timeseries-out", str(ts_path)])
+    tel = doc["extra"]["telemetry"]
+    assert tel["counter_totals"]["instrs_retired"] \
+        == doc["instrs_retired"]
+    assert tel["queue_depth_peak"] == doc["queue_depth_peak"]
+    series = json.loads(ts_path.read_text())
+    assert series["cycles"] == doc["steps"]
+
+
+# -- perfetto -------------------------------------------------------------
+
+def test_perfetto_trace_valid_with_tracks(tmp_path, monkeypatch,
+                                          capsys):
+    out = tmp_path / "trace.json"
+    rc, _, err = run_cli(
+        ["trace", "mini", "--tests-root", FIXTURES, "--cpu",
+         "--perfetto", str(out)], tmp_path, monkeypatch, capsys)
+    assert rc == 0 and out.exists()
+    doc = perfetto.validate_trace(json.loads(out.read_text()))
+    tracks = perfetto.tracks(doc)
+    assert set(tracks) == {0, 1, 2, 3}
+    for n in range(4):
+        assert tracks[n] == {"instr", "msg"}, n
+    instr = [e for e in doc["traceEvents"]
+             if e.get("cat") == "instr"]
+    # 13 instructions in the mini fixture -> 13 instr slices
+    assert len(instr) == 13
+    # slice names carry the decoded op
+    assert all(e["name"].split()[0] in ("RD", "WR") for e in instr)
+
+
+def test_perfetto_deep_engine_retirement_tracks(tmp_path, monkeypatch,
+                                                capsys):
+    out = tmp_path / "deep.json"
+    rc, _, err = run_cli(
+        ["trace", "--workload", "uniform", "--cpu", "--engine", "deep",
+         "--perfetto", str(out)], tmp_path, monkeypatch, capsys)
+    assert rc == 0
+    doc = perfetto.validate_trace(json.loads(out.read_text()))
+    instr = [e for e in doc["traceEvents"] if e.get("cat") == "instr"]
+    assert len(instr) == 128    # 4 nodes x 32 uniform instructions
+    assert not [e for e in doc["traceEvents"] if e.get("cat") == "msg"]
+
+
+def test_validate_trace_rejects_malformed():
+    with pytest.raises(ValueError):
+        perfetto.validate_trace({"notTraceEvents": []})
+    with pytest.raises(ValueError, match="bad ph"):
+        perfetto.validate_trace({"traceEvents": [{"ph": "Z", "pid": 0,
+                                                  "name": "x"}]})
+    with pytest.raises(ValueError, match="missing ts"):
+        perfetto.validate_trace({"traceEvents": [
+            {"ph": "X", "pid": 0, "tid": 0, "name": "x", "dur": 1}]})
+
+
+# -- phase timers ---------------------------------------------------------
+
+def test_phase_timer_accumulates():
+    t = PhaseTimer()
+    with t.phase("a"):
+        pass
+    with t.phase("a"):
+        pass
+    t.add("b", 1.5)
+    rep = t.report()
+    assert rep["phases"]["a"]["count"] == 2
+    assert rep["phases"]["b"] == {"seconds": 1.5, "count": 1}
+    assert rep["total_seconds"] >= 1.5
+    assert list(rep["phases"]) == ["a", "b"]   # insertion order
+
+
+def test_stats_phases_flag(tmp_path, monkeypatch, capsys):
+    doc = _stats("async", tmp_path, monkeypatch, capsys, ["--phases"])
+    phases = doc["extra"]["phases"]["phases"]
+    assert {"build", "run", "device_get"} <= set(phases)
+
+
+# -- checkpoint forward-compat -------------------------------------------
+
+def test_old_checkpoint_without_obs_metrics_loads(tmp_path):
+    """Checkpoints written before the obs counters existed resume with
+    neutral zeros (same pattern as horizon/order_rank)."""
+    from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+    from ue22cs343bb1_openmp_assignment_tpu.models.system import (
+        CoherenceSystem)
+    from ue22cs343bb1_openmp_assignment_tpu.utils import checkpoint
+    cfg = SystemConfig(num_nodes=4)
+    system = CoherenceSystem.from_workload(cfg, "uniform",
+                                           trace_len=8).run_cycles(5)
+    path = tmp_path / "new.npz"
+    checkpoint.save_checkpoint(str(path), cfg, system.state)
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files}
+    for k in ("metrics.lat_hist", "metrics.mb_depth_peak"):
+        assert k in arrays      # new checkpoints carry the fields
+        del arrays[k]           # ...old ones did not
+    old = tmp_path / "old.npz"
+    with open(old, "wb") as f:
+        np.savez(f, **arrays)
+    _, state, _ = checkpoint.load_checkpoint(str(old))
+    assert np.asarray(state.metrics.lat_hist).sum() == 0
+    assert int(state.metrics.mb_depth_peak) == 0
+    assert int(state.metrics.instrs_retired) \
+        == int(system.state.metrics.instrs_retired)

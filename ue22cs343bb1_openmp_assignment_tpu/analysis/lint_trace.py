@@ -521,9 +521,11 @@ def lint_file(path) -> List[Finding]:
 
 
 def default_targets() -> List[pathlib.Path]:
-    """The traced packages this linter gates: ops/, parallel/, models/."""
+    """The traced packages this linter gates: ops/, parallel/,
+    models/, obs/ (obs is host-side rendering, but it imports traced
+    constants and must never grow device code silently)."""
     pkg = pathlib.Path(__file__).resolve().parents[1]
-    return [pkg / d for d in ("ops", "parallel", "models") if
+    return [pkg / d for d in ("ops", "parallel", "models", "obs") if
             (pkg / d).is_dir()]
 
 

@@ -56,9 +56,9 @@ from ue22cs343bb1_openmp_assignment_tpu import codec
 from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
 from ue22cs343bb1_openmp_assignment_tpu.ops import handlers, invariants, \
     mailbox, step
-from ue22cs343bb1_openmp_assignment_tpu.state import (MB_BV0, MB_TYPE,
-                                                      Metrics, SimState,
-                                                      init_state)
+from ue22cs343bb1_openmp_assignment_tpu.state import (LAT_BUCKETS, MB_BV0,
+                                                      MB_TYPE, Metrics,
+                                                      SimState, init_state)
 from ue22cs343bb1_openmp_assignment_tpu.types import (CACHE_STATE_NAMES,
                                                       DIR_STATE_NAMES, Msg,
                                                       Op)
@@ -349,7 +349,9 @@ class ModelChecker:
                 write_hits=z32, read_misses=z32, write_misses=z32,
                 upgrades=z32, msgs_processed=np.zeros((13,), np.int32),
                 msgs_dropped=z32, msgs_injected_dropped=z32,
-                invalidations=z32, evictions=z32),
+                invalidations=z32, evictions=z32,
+                lat_hist=np.zeros((LAT_BUCKETS,), np.int32),
+                mb_depth_peak=z32),
         )
 
     def _read_back(self, a: AState, event, res, k):

@@ -384,12 +384,11 @@ def _main_sync(args) -> int:
     if args.test_dir or args.dump:
         write_dumps(cfg, se.to_dump_view(cfg, st), args.out_dir)
     if args.metrics:
+        from ue22cs343bb1_openmp_assignment_tpu.obs import schema as obs
         m = {f: int(getattr(st.metrics, f))
-             for f in ("rounds", "instrs_retired", "read_hits",
-                       "write_hits", "read_misses", "write_misses",
-                       "upgrades", "conflicts", "evictions",
-                       "invalidations", "promotions")}
-        print(json.dumps(m), file=sys.stderr)
+             for f in st.metrics.__dataclass_fields__}
+        engine = "deep" if cfg.deep_window else "sync"
+        print(json.dumps(obs.from_sync(m, engine)), file=sys.stderr)
     return 0
 
 
@@ -465,7 +464,8 @@ def _main_native(args) -> int:
         write_dumps(cfg, _t.SimpleNamespace(**eng.export_state()),
                     args.out_dir)
     if args.metrics:
-        print(json.dumps(eng.metrics()), file=sys.stderr)
+        from ue22cs343bb1_openmp_assignment_tpu.obs import schema as obs
+        print(json.dumps(obs.from_native(eng.metrics())), file=sys.stderr)
     return 0
 
 
@@ -586,6 +586,12 @@ def main(argv=None) -> int:
         # the simulator's positional workload argument)
         from ue22cs343bb1_openmp_assignment_tpu.analysis import runner
         return runner.main(raw[1:])
+    if raw[:1] == ["stats"]:
+        from ue22cs343bb1_openmp_assignment_tpu.obs import cli as obs_cli
+        return obs_cli.main_stats(raw[1:])
+    if raw[:1] == ["trace"]:
+        from ue22cs343bb1_openmp_assignment_tpu.obs import cli as obs_cli
+        return obs_cli.main_trace(raw[1:])
     args = build_parser().parse_args(raw)
     if args.cpu:
         import jax
@@ -760,7 +766,8 @@ def main(argv=None) -> int:
     if args.test_dir or args.dump:  # golden dumps (trace or forced)
         system.write_dumps(args.out_dir)
     if args.metrics:
-        print(json.dumps(system.metrics), file=sys.stderr)
+        from ue22cs343bb1_openmp_assignment_tpu.obs import schema as obs
+        print(json.dumps(obs.from_async(system.metrics)), file=sys.stderr)
     return 0
 
 

@@ -1,0 +1,33 @@
+"""obs — the observability layer (host-side).
+
+One event/metric surface for all engines:
+
+- :mod:`obs.schema` — the unified ``cache-sim/metrics/v1`` report every
+  ``--metrics`` path and ``cache-sim stats`` emits, with adapters from
+  each engine's native counter dict and a dependency-free validator.
+- :mod:`obs.perfetto` — Chrome/Perfetto trace-event JSON export of
+  eventlog records (per-node instr and msg tracks; open in
+  ui.perfetto.dev).
+- :mod:`obs.phases` — wall-clock phase timers (compile / dispatch /
+  device_get sync), wired into bench.py.
+- :mod:`obs.timeseries` — host rendering of the on-device telemetry
+  samples (ops.step.run_cycles_telemetry).
+- :mod:`obs.cli` — the ``cache-sim stats`` / ``cache-sim trace``
+  subcommands.
+
+Everything in this package is host-side: it renders device arrays after
+the run; nothing here is traced (the on-device capture lives in
+ops/step.py where the jit discipline applies).
+"""
+
+from ue22cs343bb1_openmp_assignment_tpu.obs.phases import PhaseTimer
+from ue22cs343bb1_openmp_assignment_tpu.obs.schema import (
+    SCHEMA_ID,
+    from_async,
+    from_native,
+    from_sync,
+    validate,
+)
+
+__all__ = ["PhaseTimer", "SCHEMA_ID", "from_async", "from_native",
+           "from_sync", "validate"]

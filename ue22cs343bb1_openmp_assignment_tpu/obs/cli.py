@@ -1,0 +1,313 @@
+"""``cache-sim stats`` / ``cache-sim trace`` — the obs CLI surfaces.
+
+``stats`` runs a workload or fixture on any engine and prints the
+unified ``cache-sim/metrics/v1`` report (obs.schema) to stdout —
+deterministic JSON (sorted keys) so goldens diff cleanly. With
+``--timeseries`` the async engine re-runs under the on-device
+telemetry capture and a host summary rides in ``extra``; the full
+per-cycle series can be written aside with ``--timeseries-out``.
+
+``trace --perfetto OUT`` exports the run's event record as
+Chrome/Perfetto trace-event JSON (obs.perfetto): per-node ``instr``
+and ``msg`` tracks from the async engine, retirement tracks from the
+sync/deep engine. Open the file in ui.perfetto.dev.
+"""
+# lint: host
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+WORKLOADS = ["uniform", "producer_consumer", "false_sharing", "fft",
+             "radix", "hotspot", "lu"]
+
+
+# lint: host
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("test_dir", nargs="?", default=None,
+                   help="test directory name (fixture traces)")
+    p.add_argument("--tests-root", default="tests")
+    p.add_argument("--workload", choices=WORKLOADS,
+                   help="synthetic workload instead of trace files")
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument("--trace-len", type=int, default=32)
+    p.add_argument("--seed", type=int, default=0,
+                   help="workload PRNG seed")
+    p.add_argument("--max-cycles", type=int, default=100_000)
+    p.add_argument("--run-cycles", type=int, default=None,
+                   help="run exactly this many cycles/rounds instead "
+                        "of running to quiescence")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend")
+
+
+# lint: host
+def build_stats_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cache-sim stats",
+        description="run a workload and emit the unified metrics "
+                    "report (cache-sim/metrics/v1) to stdout")
+    _add_common(p)
+    p.add_argument("--engine", choices=["async", "sync", "native"],
+                   default="async")
+    p.add_argument("--timeseries", action="store_true",
+                   help="async engine: capture the on-device per-cycle "
+                        "telemetry and attach a summary under extra")
+    p.add_argument("--timeseries-out", metavar="PATH",
+                   help="also write the full per-cycle series JSON "
+                        "(implies --timeseries)")
+    p.add_argument("--phases", action="store_true",
+                   help="attach wall-clock phase timings under extra "
+                        "(off by default: timings are nondeterministic "
+                        "and would break golden diffs)")
+    p.add_argument("--out", metavar="PATH",
+                   help="write the report here instead of stdout")
+    return p
+
+
+# lint: host
+def build_trace_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cache-sim trace",
+        description="run a workload and export its event record as "
+                    "Perfetto/Chrome trace-event JSON")
+    _add_common(p)
+    p.add_argument("--perfetto", metavar="PATH", required=True,
+                   help="output path for the trace-event JSON")
+    p.add_argument("--engine", choices=["async", "deep"],
+                   default="async",
+                   help="async = instr+msg dequeue tracks; deep = "
+                        "transactional-engine retirement tracks")
+    p.add_argument("--no-msgs", action="store_true",
+                   help="async engine: omit the msg tracks")
+    return p
+
+
+# lint: host
+def _async_system(args):
+    from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+    from ue22cs343bb1_openmp_assignment_tpu.models.system import (
+        CoherenceSystem)
+    if args.workload:
+        cfg = SystemConfig.scale(num_nodes=args.nodes)
+        return CoherenceSystem.from_workload(
+            cfg, args.workload, trace_len=args.trace_len, seed=args.seed)
+    if args.test_dir:
+        cfg = SystemConfig.reference(num_nodes=args.nodes)
+        path = os.path.join(args.tests_root, args.test_dir)
+        return CoherenceSystem.from_test_dir(path, cfg)
+    return None
+
+
+# lint: host
+def _emit(args, doc: dict) -> None:
+    text = json.dumps(doc, indent=2, sort_keys=True) + "\n"
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+
+
+# lint: host
+def cmd_stats(args) -> int:
+    from ue22cs343bb1_openmp_assignment_tpu.obs import schema
+    from ue22cs343bb1_openmp_assignment_tpu.obs.phases import PhaseTimer
+    timer = PhaseTimer()
+    want_ts = args.timeseries or args.timeseries_out
+
+    if args.engine == "native":
+        if want_ts:
+            print("error: --timeseries is on-device capture; use "
+                  "--engine async", file=sys.stderr)
+            return 2
+        doc = _stats_native(args, timer)
+    elif args.engine == "sync":
+        if want_ts:
+            print("error: --timeseries needs the message-level engine; "
+                  "use --engine async", file=sys.stderr)
+            return 2
+        doc = _stats_sync(args, timer)
+    else:
+        doc = _stats_async(args, timer, want_ts)
+    if doc is None:
+        print("error: provide <test_directory> or --workload",
+              file=sys.stderr)
+        return 2
+    if args.phases:
+        doc["extra"]["phases"] = timer.report()
+    _emit(args, schema.validate(doc))
+    return 0
+
+
+# lint: host
+def _stats_async(args, timer, want_ts: bool):
+    from ue22cs343bb1_openmp_assignment_tpu.obs import schema, timeseries
+    from ue22cs343bb1_openmp_assignment_tpu.ops import step
+    with timer.phase("build"):
+        system0 = _async_system(args)
+    if system0 is None:
+        return None
+    with timer.phase("run"):
+        if args.run_cycles is not None:
+            system = system0.run_cycles(args.run_cycles)
+        else:
+            system = system0.run(args.max_cycles)
+    with timer.phase("device_get"):
+        m = system.metrics
+    doc = schema.from_async(m)
+    if want_ts:
+        # telemetry replays the run from the initial state for exactly
+        # the cycle count the plain run took — same trajectory (the
+        # engine is deterministic), now with the per-cycle capture
+        with timer.phase("telemetry_run"):
+            _, telem = step.run_cycles_telemetry(
+                system0.cfg, system0.state, int(m["cycles"]))
+        with timer.phase("device_get"):
+            doc["extra"]["telemetry"] = timeseries.summarize(telem)
+        if args.timeseries_out:
+            with open(args.timeseries_out, "w") as f:
+                json.dump(timeseries.to_series(telem), f)
+                f.write("\n")
+    return doc
+
+
+# lint: host
+def _stats_sync(args, timer):
+    from ue22cs343bb1_openmp_assignment_tpu.models.transactional import (
+        TransactionalSystem)
+    from ue22cs343bb1_openmp_assignment_tpu.obs import schema
+    with timer.phase("build"):
+        if args.workload:
+            from ue22cs343bb1_openmp_assignment_tpu.config import (
+                SystemConfig)
+            cfg = SystemConfig.scale(num_nodes=args.nodes)
+            ts = TransactionalSystem.from_workload(
+                cfg, args.workload, trace_len=args.trace_len,
+                workload_seed=args.seed)
+        elif args.test_dir:
+            path = os.path.join(args.tests_root, args.test_dir)
+            ts = TransactionalSystem.from_test_dir(path)
+        else:
+            return None
+    with timer.phase("run"):
+        if args.run_cycles is not None:
+            ts = ts.run_rounds(args.run_cycles)
+        else:
+            ts = ts.run(max_rounds=args.max_cycles)
+    with timer.phase("device_get"):
+        m = ts.metrics
+    return schema.from_sync(m)
+
+
+# lint: host
+def _stats_native(args, timer):
+    import numpy as np
+
+    from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+    from ue22cs343bb1_openmp_assignment_tpu.native.bindings import (
+        NativeEngine)
+    from ue22cs343bb1_openmp_assignment_tpu.obs import schema
+    with timer.phase("build"):
+        if args.workload:
+            import jax
+            from ue22cs343bb1_openmp_assignment_tpu.models import (
+                workloads)
+            cfg = SystemConfig.scale(num_nodes=args.nodes,
+                                     max_instrs=args.trace_len)
+            arrs = workloads.GENERATORS[args.workload](
+                jax.random.PRNGKey(args.seed), cfg, args.trace_len)
+            eng = NativeEngine(cfg)
+            eng.load_instr_arrays(*(np.asarray(a) for a in arrs))
+        elif args.test_dir:
+            from ue22cs343bb1_openmp_assignment_tpu.utils.trace import (
+                load_test_dir)
+            cfg = SystemConfig.reference(num_nodes=args.nodes)
+            path = os.path.join(args.tests_root, args.test_dir)
+            eng = NativeEngine(cfg)
+            eng.load_traces(load_test_dir(path, cfg.num_nodes,
+                                          cfg.max_instrs))
+        else:
+            return None
+    with timer.phase("run"):
+        eng.run(args.run_cycles if args.run_cycles is not None
+                else args.max_cycles)
+    return schema.from_native(eng.metrics())
+
+
+# lint: host
+def cmd_trace(args) -> int:
+    from ue22cs343bb1_openmp_assignment_tpu.obs import perfetto
+    from ue22cs343bb1_openmp_assignment_tpu.utils import eventlog
+
+    if args.engine == "deep":
+        from ue22cs343bb1_openmp_assignment_tpu.models.transactional \
+            import TransactionalSystem
+        from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+        if args.workload:
+            from ue22cs343bb1_openmp_assignment_tpu.config import (
+                SystemConfig)
+            cfg = SystemConfig.scale(num_nodes=args.nodes)
+            ts = TransactionalSystem.from_workload(
+                cfg, args.workload, trace_len=args.trace_len,
+                workload_seed=args.seed)
+        elif args.test_dir:
+            path = os.path.join(args.tests_root, args.test_dir)
+            ts = TransactionalSystem.from_test_dir(path)
+        else:
+            print("error: provide <test_directory> or --workload",
+                  file=sys.stderr)
+            return 2
+        if args.run_cycles is not None:
+            rounds = args.run_cycles
+        else:
+            # find the round count first, then replay traced
+            done = ts.run(max_rounds=args.max_cycles)
+            rounds = int(done.metrics["rounds"])
+        _, events = se.run_rounds_traced(ts.cfg, ts.state, rounds)
+        records = eventlog.sync_to_records(events)
+        num_nodes = ts.cfg.num_nodes
+    else:
+        system = _async_system(args)
+        if system is None:
+            print("error: provide <test_directory> or --workload",
+                  file=sys.stderr)
+            return 2
+        base = int(system.state.cycle)
+        if args.run_cycles is not None:
+            system, events = system.run_cycles_traced(args.run_cycles)
+        else:
+            system, events = system.run_traced(args.max_cycles)
+        records = (eventlog.to_records(events, base) if events else [])
+        if args.no_msgs:
+            records = [r for r in records if r["kind"] == "instr"]
+        num_nodes = system.cfg.num_nodes
+
+    doc = perfetto.build_trace(records, num_nodes)
+    perfetto.validate_trace(doc)
+    perfetto.write_trace(args.perfetto, doc)
+    print(f"wrote {args.perfetto}: {len(records)} events across "
+          f"{num_nodes} nodes (open in ui.perfetto.dev)",
+          file=sys.stderr)
+    return 0
+
+
+# lint: host
+def main_stats(argv) -> int:
+    args = build_stats_parser().parse_args(argv)
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    return cmd_stats(args)
+
+
+# lint: host
+def main_trace(argv) -> int:
+    args = build_trace_parser().parse_args(argv)
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    return cmd_trace(args)

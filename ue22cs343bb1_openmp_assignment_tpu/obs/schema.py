@@ -1,0 +1,199 @@
+"""The unified cross-engine metrics schema: ``cache-sim/metrics/v1``.
+
+Before this module each engine's ``--metrics`` dump had its own shape
+(async: the raw Metrics pytree, sync: a hand-picked field subset,
+native: the C++ counter vector) — three mutually incompatible schemas
+for one protocol. Every metrics surface now routes through here: the
+adapters (:func:`from_async`, :func:`from_sync`, :func:`from_native`)
+normalize each engine's native dict into one report, and
+:func:`validate` checks it without any external dependency.
+
+Report layout (every field always present; ``None`` marks a counter
+the producing engine does not measure — *not* zero):
+
+==================== ====================================================
+key                  meaning
+==================== ====================================================
+schema               literal ``"cache-sim/metrics/v1"``
+engine               producing engine (``async``/``sync``/``deep``/
+                     ``native``)
+steps                engine time steps executed
+step_unit            what a step is: ``"cycles"`` (async/native) or
+                     ``"rounds"`` (sync/deep transactions)
+instrs_retired, read_hits, write_hits, read_misses, write_misses,
+upgrades, invalidations, evictions
+                     the eight core counters, flat at top level (ints)
+messages             {processed_total, by_type, dropped_overflow,
+                     dropped_injected} — message-level engines only
+queue_depth_peak     max mailbox occupancy seen on any node
+latency_cycles       {bucket_lo, counts}: miss-latency histogram,
+                     bucket b counts waits with issue→retire latency in
+                     [bucket_lo[b], next lo); last bucket open-ended
+extra                engine-specific counters that have no cross-engine
+                     meaning (e.g. sync conflicts/promotions)
+==================== ====================================================
+
+The eight core counters stay flat at top level on purpose: pre-existing
+tooling (and tests/test_cli_engines.py) reads
+``metrics["instrs_retired"]`` directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ue22cs343bb1_openmp_assignment_tpu.types import MSG_NAMES
+
+SCHEMA_ID = "cache-sim/metrics/v1"
+
+#: the eight cross-engine core counters, flat at top level of the report
+CORE_COUNTERS = ("instrs_retired", "read_hits", "write_hits",
+                 "read_misses", "write_misses", "upgrades",
+                 "invalidations", "evictions")
+
+_TOP_KEYS = (("schema", "engine", "steps", "step_unit") + CORE_COUNTERS
+             + ("messages", "queue_depth_peak", "latency_cycles", "extra"))
+
+_MSG_KEYS = ("processed_total", "by_type", "dropped_overflow",
+             "dropped_injected")
+
+
+# lint: host
+def _report(engine: str, steps: int, step_unit: str, counters: dict,
+            messages: Optional[dict] = None,
+            queue_depth_peak: Optional[int] = None,
+            latency_cycles: Optional[dict] = None,
+            extra: Optional[dict] = None) -> dict:
+    doc = {"schema": SCHEMA_ID, "engine": engine, "steps": int(steps),
+           "step_unit": step_unit}
+    for k in CORE_COUNTERS:
+        doc[k] = int(counters[k])
+    doc["messages"] = (dict.fromkeys(_MSG_KEYS) if messages is None
+                      else {k: messages.get(k) for k in _MSG_KEYS})
+    doc["queue_depth_peak"] = queue_depth_peak
+    doc["latency_cycles"] = latency_cycles
+    doc["extra"] = extra or {}
+    return doc
+
+
+# lint: host
+def latency_histogram(counts) -> Optional[dict]:
+    """Render a LAT_BUCKETS-long count vector as the report's
+    ``latency_cycles`` object (power-of-two bucket_lo edges); None when
+    no wait ever completed (all-zero histogram from a run with no
+    misses is still emitted — None means the engine didn't measure)."""
+    counts = [int(c) for c in counts]
+    return {"bucket_lo": [1 << b for b in range(len(counts))],
+            "counts": counts}
+
+
+# lint: host
+def from_async(m: dict, engine: str = "async") -> dict:
+    """CoherenceSystem.metrics (the async Metrics pytree as a dict) →
+    unified report."""
+    by_type = {name: int(c)
+               for name, c in zip(MSG_NAMES, m["msgs_processed"])}
+    return _report(
+        engine, m["cycles"], "cycles", m,
+        messages={"processed_total": sum(by_type.values()),
+                  "by_type": by_type,
+                  "dropped_overflow": int(m["msgs_dropped"]),
+                  "dropped_injected": int(m["msgs_injected_dropped"])},
+        queue_depth_peak=int(m["mb_depth_peak"]),
+        latency_cycles=latency_histogram(m["lat_hist"]))
+
+
+# lint: host
+def from_sync(m: dict, engine: str = "sync") -> dict:
+    """TransactionalSystem.metrics (SyncMetrics as a dict) → unified
+    report. The transactional engine has no message plane or wait
+    latency — those stay None; its engine-specific counters (claim
+    conflicts, S→E promotions) go to ``extra``."""
+    return _report(
+        engine, m["rounds"], "rounds", m,
+        extra={"conflicts": int(m["conflicts"]),
+               "promotions": int(m["promotions"])})
+
+
+# lint: host
+def from_native(m: dict, engine: str = "native") -> dict:
+    """NativeEngine.metrics() (the C++ counter vector) → unified
+    report. The oracle counts dequeues and drops but not per-type or
+    latency."""
+    return _report(
+        engine, m["cycles"], "cycles", m,
+        messages={"processed_total": None, "by_type": None,
+                  "dropped_overflow": int(m["msgs_dropped"]),
+                  "dropped_injected": None})
+
+
+# lint: host
+def validate(doc: dict) -> dict:
+    """Check a report against the v1 schema; returns the doc, raises
+    ValueError listing every violation. Dependency-free on purpose —
+    the container has no jsonschema."""
+    errs = []
+    if not isinstance(doc, dict):
+        raise ValueError(f"report must be a dict, got {type(doc).__name__}")
+    for k in _TOP_KEYS:
+        if k not in doc:
+            errs.append(f"missing key: {k}")
+    for k in doc:
+        if k not in _TOP_KEYS:
+            errs.append(f"unknown key: {k}")
+    if doc.get("schema") != SCHEMA_ID:
+        errs.append(f"schema must be {SCHEMA_ID!r}, got {doc.get('schema')!r}")
+    if not isinstance(doc.get("engine"), str):
+        errs.append("engine must be a string")
+    if doc.get("step_unit") not in ("cycles", "rounds"):
+        errs.append(f"step_unit must be cycles|rounds, "
+                    f"got {doc.get('step_unit')!r}")
+    for k in ("steps",) + CORE_COUNTERS:
+        v = doc.get(k)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errs.append(f"{k} must be a non-negative int, got {v!r}")
+    msgs = doc.get("messages")
+    if not isinstance(msgs, dict):
+        errs.append("messages must be a dict")
+    else:
+        for k in _MSG_KEYS:
+            if k not in msgs:
+                errs.append(f"messages missing key: {k}")
+        for k in ("processed_total", "dropped_overflow",
+                  "dropped_injected"):
+            v = msgs.get(k)
+            if v is not None and (not isinstance(v, int) or v < 0):
+                errs.append(f"messages.{k} must be None or "
+                            f"non-negative int, got {v!r}")
+        bt = msgs.get("by_type")
+        if bt is not None:
+            if not isinstance(bt, dict) or not all(
+                    isinstance(v, int) and v >= 0 for v in bt.values()):
+                errs.append("messages.by_type must be None or a dict of "
+                            "non-negative ints")
+            elif (msgs.get("processed_total") is not None
+                  and sum(bt.values()) != msgs["processed_total"]):
+                errs.append("messages.by_type does not sum to "
+                            "processed_total")
+    q = doc.get("queue_depth_peak")
+    if q is not None and (not isinstance(q, int) or q < 0):
+        errs.append(f"queue_depth_peak must be None or non-negative "
+                    f"int, got {q!r}")
+    lat = doc.get("latency_cycles")
+    if lat is not None:
+        if (not isinstance(lat, dict)
+                or set(lat) != {"bucket_lo", "counts"}):
+            errs.append("latency_cycles must be None or "
+                        "{bucket_lo, counts}")
+        elif (len(lat["bucket_lo"]) != len(lat["counts"])
+              or lat["bucket_lo"] != sorted(set(lat["bucket_lo"]))
+              or any(not isinstance(c, int) or c < 0
+                     for c in lat["counts"])):
+            errs.append("latency_cycles bucket_lo must be strictly "
+                        "increasing and counts non-negative ints of "
+                        "the same length")
+    if not isinstance(doc.get("extra"), dict):
+        errs.append("extra must be a dict")
+    if errs:
+        raise ValueError("invalid metrics report:\n  " + "\n  ".join(errs))
+    return doc

@@ -1,0 +1,69 @@
+"""Host rendering of the on-device telemetry time-series.
+
+ops.step.run_cycles_telemetry stacks one fixed-shape sample per cycle
+on device (counter deltas, per-type dequeues, queue-depth watermarks,
+directory occupancy, latency-histogram deltas) — this module turns the
+fetched [T, ...] arrays into named JSON-ready series and compact
+summaries for ``cache-sim stats --timeseries``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ue22cs343bb1_openmp_assignment_tpu.ops.step import TELEMETRY_COUNTERS
+from ue22cs343bb1_openmp_assignment_tpu.types import MSG_NAMES
+
+DIR_STATES = ("EM", "S", "U")
+
+
+# lint: host
+def _np(telem: Dict) -> Dict[str, np.ndarray]:
+    return {k: np.asarray(v) for k, v in telem.items()}
+
+
+# lint: host
+def to_series(telem: Dict) -> dict:
+    """Fetched telemetry dict → {"cycles": T, "series": {name: [T]
+    ints}} with every named channel unpacked (counter deltas by
+    counter name, dequeues by message type, occupancy by directory
+    state)."""
+    t = _np(telem)
+    series: Dict[str, list] = {}
+    for i, name in enumerate(TELEMETRY_COUNTERS):
+        series[name] = t["counters"][:, i].tolist()
+    for i, name in enumerate(MSG_NAMES):
+        series[f"msgs_{name}"] = t["msgs_processed"][:, i].tolist()
+    for i, name in enumerate(DIR_STATES):
+        series[f"dir_{name}"] = t["dir_occupancy"][:, i].tolist()
+    for key in ("queue_depth_max", "queue_depth_total", "waiting_nodes",
+                "msgs_dropped", "msgs_injected_dropped"):
+        series[key] = t[key].tolist()
+    return {"cycles": int(t["counters"].shape[0]), "series": series}
+
+
+# lint: host
+def summarize(telem: Dict) -> dict:
+    """Compact per-channel rollup: totals for deltas, peaks for
+    watermarks/gauges — the cheap alternative when the full series
+    would be unwieldy."""
+    t = _np(telem)
+    counters = {name: int(t["counters"][:, i].sum())
+                for i, name in enumerate(TELEMETRY_COUNTERS)}
+    return {
+        "cycles": int(t["counters"].shape[0]),
+        "counter_totals": counters,
+        "msgs_by_type": {name: int(t["msgs_processed"][:, i].sum())
+                         for i, name in enumerate(MSG_NAMES)},
+        "queue_depth_peak": int(t["queue_depth_max"].max(initial=0)),
+        "queue_depth_total_peak": int(
+            t["queue_depth_total"].max(initial=0)),
+        "waiting_nodes_peak": int(t["waiting_nodes"].max(initial=0)),
+        "dir_occupancy_last": {
+            name: int(t["dir_occupancy"][-1, i])
+            for i, name in enumerate(DIR_STATES)
+        } if t["dir_occupancy"].shape[0] else None,
+        "lat_hist_total": t["lat_hist"].sum(axis=0).astype(int).tolist(),
+    }

@@ -32,12 +32,20 @@ import jax.numpy as jnp
 from ue22cs343bb1_openmp_assignment_tpu import codec
 from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
 from ue22cs343bb1_openmp_assignment_tpu.ops import frontend, handlers, mailbox
-from ue22cs343bb1_openmp_assignment_tpu.state import SimState
-from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, Msg
+from ue22cs343bb1_openmp_assignment_tpu.state import LAT_BUCKETS, SimState
+from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState, Msg
+
+#: names of the per-cycle counter-delta vector emitted in telemetry
+#: mode (cycle(with_telemetry=True) / run_cycles_telemetry), in order —
+#: the same composition the cumulative Metrics update uses
+TELEMETRY_COUNTERS = ("instrs_retired", "read_hits", "write_hits",
+                      "read_misses", "write_misses", "upgrades",
+                      "invalidations", "evictions")
 
 
 def cycle(cfg: SystemConfig, state: SimState,
-          with_events: bool = False, message_phase=None):
+          with_events: bool = False, message_phase=None,
+          with_telemetry: bool = False):
     """Advance the whole machine by one cycle.
 
     Cross-sender arbitration order for this cycle's deliveries comes from
@@ -55,6 +63,14 @@ def cycle(cfg: SystemConfig, state: SimState,
     static model checker uses this to drive *mutated* handlers through
     the unmodified engine (analysis/mutations.py); production callers
     leave it None.
+
+    ``with_telemetry=True`` additionally returns this cycle's telemetry
+    sample (obs layer): the counter-delta vector (TELEMETRY_COUNTERS
+    order), per-type message dequeues, mailbox queue-depth watermarks,
+    directory-state occupancy and the miss-latency histogram delta —
+    all fixed-shape device scalars/vectors, so lax.scan stacks them
+    into a time-series without leaving the jit graph. With both event
+    and telemetry capture on, the return is ``(state, events, telem)``.
     """
     if message_phase is None:
         message_phase = handlers.message_phase
@@ -195,20 +211,31 @@ def cycle(cfg: SystemConfig, state: SimState,
     # ---- metrics ---------------------------------------------------------
     # ONE stacked reduction for every per-node counter delta, including
     # the per-message-type histogram (a one-hot instead of a scatter-add)
-    # — separate sums/scatters each cost a kernel dispatch (PERF.md)
+    # and the miss-latency histogram — separate sums/scatters each cost
+    # a kernel dispatch (PERF.md)
     mt = state.metrics
     has, t = m_stats["msg_type_onehot"]
     K = mt.msgs_processed.shape[0]                # message-type count
     type_onehot = (jnp.arange(K, dtype=jnp.int32)[:, None] == t[None, :]) \
         & has[None, :]                                          # [K, N]
+    # miss-latency histogram input: nodes whose coherence wait cleared
+    # this cycle; latency = issue cycle (waiting_since) to retire cycle,
+    # bucketed as floor(log2) into LAT_BUCKETS power-of-two bins
+    unblocked = m_stats["unblocked"]
+    lat = jnp.maximum(state.cycle - state.waiting_since, 1)
+    bucket = jnp.clip(31 - jax.lax.clz(lat), 0, LAT_BUCKETS - 1)
+    lat_onehot = (jnp.arange(LAT_BUCKETS, dtype=jnp.int32)[:, None]
+                  == bucket[None, :]) & unblocked[None, :]      # [B, N]
     counters = jnp.stack([
         f_stats["issued"], f_stats["read_hits"], f_stats["write_hits"],
         f_stats["read_misses"], f_stats["write_misses"],
         f_stats["upgrades"], m_stats["invalidations"],
         m_stats["evictions"],
     ])                                                          # [8, N]
-    deltas = jnp.sum(jnp.concatenate([counters, type_onehot]).astype(
-        jnp.int32), axis=1)                                     # [8 + K]
+    deltas = jnp.sum(jnp.concatenate(
+        [counters, type_onehot, lat_onehot]).astype(jnp.int32),
+        axis=1)                                     # [8 + K + B]
+    depth_peak = jnp.max(mb_upd["mb_count"])
     metrics = mt.replace(
         cycles=mt.cycles + 1,
         instrs_retired=mt.instrs_retired + deltas[0],
@@ -222,6 +249,8 @@ def cycle(cfg: SystemConfig, state: SimState,
         msgs_injected_dropped=mt.msgs_injected_dropped + injected,
         invalidations=mt.invalidations + deltas[6] + inv_applied,
         evictions=mt.evictions + deltas[7],
+        lat_hist=mt.lat_hist + deltas[8 + K:],
+        mb_depth_peak=jnp.maximum(mt.mb_depth_peak, depth_peak),
     )
 
     new_state = state.replace(
@@ -231,16 +260,43 @@ def cycle(cfg: SystemConfig, state: SimState,
         cur_op=cur_op, cur_addr=cur_addr, cur_val=cur_val, waiting=waiting,
         waiting_since=waiting_since,
         cycle=state.cycle + 1, metrics=metrics, **mb_upd)
-    if not with_events:
+    if not with_events and not with_telemetry:
         return new_state
-    events = {
-        # instruction fetch (assignment.c:649-652)
-        "fetch": fetch, "op": l_op, "addr": l_addr, "value": l_val,
-        # message dequeue (assignment.c:179-182)
-        "msg": mv.has_msg, "msg_sender": mv.sender,
-        "msg_type": mv.type, "msg_addr": mv.addr,
-    }
-    return new_state, events
+    out = (new_state,)
+    if with_events:
+        events = {
+            # instruction fetch (assignment.c:649-652)
+            "fetch": fetch, "op": l_op, "addr": l_addr, "value": l_val,
+            # message dequeue (assignment.c:179-182)
+            "msg": mv.has_msg, "msg_sender": mv.sender,
+            "msg_type": mv.type, "msg_addr": mv.addr,
+        }
+        out = out + (events,)
+    if with_telemetry:
+        # fixed-shape per-cycle sample; stacked by lax.scan into the
+        # obs time-series (obs/timeseries.py renders it host-side)
+        telem = {
+            # counter deltas in TELEMETRY_COUNTERS order (invalidations
+            # include the scatter-mode INV fan-out, like the cumulative
+            # metric)
+            "counters": jnp.stack([
+                deltas[0], deltas[1], deltas[2], deltas[3], deltas[4],
+                deltas[5], deltas[6] + inv_applied, deltas[7]]),   # [8]
+            "msgs_processed": deltas[8:8 + K],                     # [K]
+            "msgs_dropped": dropped,
+            "msgs_injected_dropped": injected,
+            "lat_hist": deltas[8 + K:],                            # [B]
+            # mailbox queue-depth watermarks after this cycle's delivery
+            "queue_depth_max": depth_peak,
+            "queue_depth_total": jnp.sum(mb_upd["mb_count"]),
+            # directory-state occupancy over all (home, block) entries
+            "dir_occupancy": jnp.stack(
+                [jnp.sum(dir_state == int(s)).astype(jnp.int32)
+                 for s in (DirState.EM, DirState.S, DirState.U)]), # [3]
+            "waiting_nodes": jnp.sum(waiting).astype(jnp.int32),
+        }
+        out = out + (telem,)
+    return out
 
 
 # -- runners ---------------------------------------------------------------
@@ -284,6 +340,28 @@ def run_cycles_traced(cfg: SystemConfig, state: SimState,
 
     final, events = jax.lax.scan(body, carry0, None, length=num_cycles)
     return final.replace(**ro), events
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def run_cycles_telemetry(cfg: SystemConfig, state: SimState,
+                         num_cycles: int):
+    """Scan `num_cycles` cycles collecting the per-cycle telemetry.
+
+    Returns (state, telem) with telem a dict of [num_cycles, ...]
+    arrays (see cycle's with_telemetry contract) — the on-device
+    time-series behind ``cache-sim stats --timeseries`` and
+    obs/timeseries.py. Shape-static: every sample is fixed-size, so
+    the jit graph is independent of run length apart from the scan
+    trip count.
+    """
+    carry0, ro, blanks = _ro_outside(state)
+
+    def body(s, _):
+        out, tel = cycle(cfg, s.replace(**ro), with_telemetry=True)
+        return out.replace(**blanks), tel
+
+    final, telem = jax.lax.scan(body, carry0, None, length=num_cycles)
+    return final.replace(**ro), telem
 
 
 @functools.partial(jax.jit, static_argnums=(0, 2))

@@ -26,6 +26,13 @@ from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
 from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState, Msg, Op
 
 
+# Fixed bucket count of the miss-latency histogram (obs layer): bucket b
+# counts coherence waits whose issue->retire latency in cycles falls in
+# [2^b, 2^(b+1)); the last bucket absorbs everything beyond. Static so
+# jit graphs stay shape-static regardless of run length.
+LAT_BUCKETS = 16
+
+
 class Metrics(struct.PyTreeNode):
     """Device-side counters, reduced across nodes (SURVEY §5 observability)."""
 
@@ -41,6 +48,13 @@ class Metrics(struct.PyTreeNode):
     msgs_injected_dropped: jnp.ndarray  # [] i32 — cfg.drop_prob faults
     invalidations: jnp.ndarray   # [] i32 — INV applications that hit a line
     evictions: jnp.ndarray       # [] i32 — EVICT_* notices sent
+    # miss-latency histogram: issue->retire wait lengths in cycles,
+    # power-of-two buckets (see LAT_BUCKETS); accumulated on device so
+    # the measurement never leaves the jit graph
+    lat_hist: jnp.ndarray        # [LAT_BUCKETS] i32
+    # mailbox queue-depth high watermark over the whole run (the early
+    # overflow-pressure signal behind the silent-drop quirk 6)
+    mb_depth_peak: jnp.ndarray   # [] i32
 
     @classmethod
     def zeros(cls) -> "Metrics":
@@ -49,7 +63,9 @@ class Metrics(struct.PyTreeNode):
                    read_misses=z, write_misses=z, upgrades=z,
                    msgs_processed=jnp.zeros((13,), jnp.int32),
                    msgs_dropped=z, msgs_injected_dropped=z,
-                   invalidations=z, evictions=z)
+                   invalidations=z, evictions=z,
+                   lat_hist=jnp.zeros((LAT_BUCKETS,), jnp.int32),
+                   mb_depth_peak=z)
 
 
 # mb_pack column layout

@@ -137,6 +137,13 @@ def load_checkpoint(path: str) -> Tuple[SystemConfig, SimState, dict]:
         state_fields["order_rank"] = np.zeros(
             state_fields["instr_count"].shape + (0,), np.int32)
         got.add("order_rank")
+    if meta.get("kind", "sim") == "sim":
+        # obs-layer counters added after the checkpoint was written
+        # resume from their neutral init
+        from ue22cs343bb1_openmp_assignment_tpu.state import LAT_BUCKETS
+        metric_fields.setdefault(
+            "lat_hist", np.zeros((LAT_BUCKETS,), np.int32))
+        metric_fields.setdefault("mb_depth_peak", np.zeros((), np.int32))
     if got != expected:
         raise ValueError(f"checkpoint fields {sorted(got)} != "
                          f"state fields {sorted(expected)}")
