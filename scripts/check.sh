@@ -5,10 +5,17 @@
 #   scripts/check.sh            # analyze + tier-1 tests
 #   scripts/check.sh --analyze  # static analysis only
 #
-# The analyze step is `cache-sim analyze`: the small-scope protocol
-# model checker over the builtin scopes plus the JAX trace linter over
-# ops/ parallel/ models/ obs/. It exits nonzero on any genuine violation
-# (reference-sanctioned quirks are reported but allowlisted).
+# The analyze step is `cache-sim analyze`: the symmetry-reduced
+# protocol model checker over the builtin scopes, the JAX trace linter
+# over ops/ parallel/ models/ obs/, and the jaxpr IR lint + three-engine
+# recompilation guard (--jaxpr). It exits nonzero on any genuine
+# violation (reference-sanctioned quirks are reported but allowlisted);
+# exit 3 means a scope exhausted --max-states without a finding.
+#
+# The fuzz smoke is a fixed-seed, time-boxed run of the differential
+# fuzzer (async vs native vs sync; FUZZ_N cases, seed 0) — ≤30 s
+# wall-clock enforced by timeout(1); diverging traces are ddmin-shrunk
+# in the same invocation.
 #
 # The obs smoke step runs `cache-sim stats` on the mini fixture and
 # validates the emitted report against the cache-sim/metrics/v1 schema
@@ -18,7 +25,10 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-python -m ue22cs343bb1_openmp_assignment_tpu.analysis ${ANALYZE_ARGS:-}
+python -m ue22cs343bb1_openmp_assignment_tpu.analysis --jaxpr ${ANALYZE_ARGS:-}
+
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.analysis \
+    --skip-model-check --skip-lint --fuzz "${FUZZ_N:-16}" --seed 0
 
 python -m ue22cs343bb1_openmp_assignment_tpu.cli stats mini \
     --tests-root tests/fixtures --out /tmp/_obs_smoke.json

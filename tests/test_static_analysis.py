@@ -73,6 +73,8 @@ def test_quirks_are_allowlisted_not_silenced():
     "upgrade_keeps_other_sharers",
     "no_wait_clear_on_reply_rd",
     "drop_evict_modified",
+    "stale_owner_forward",
+    "evict_shared_keeps_bit",
 ])
 def test_mutation_is_caught(mutation):
     """Each seeded handler bug must produce exactly its expected
@@ -93,11 +95,42 @@ def test_mutation_is_caught(mutation):
 
 def test_analyze_cli_exit_codes():
     """`cache-sim analyze` is the CI gate: 0 on the shipped handlers,
-    1 under a seeded mutation (in-process to stay fast)."""
+    1 under a seeded mutation, 3 when a scope exhausts --max-states
+    without a finding (distinct from a pass — nothing was proven).
+    In-process to stay fast."""
     from ue22cs343bb1_openmp_assignment_tpu.analysis import runner
     assert runner.main(["--scopes", "2n1a", "--skip-lint", "-q"]) == 0
     assert runner.main(["--mutation", "upgrade_keeps_other_sharers",
                         "--skip-lint", "-q"]) == 1
+    assert runner.main(["--scopes", "2n1a", "--skip-lint", "-q",
+                        "--max-states", "50"]) == 3
+    # a genuine finding wins over budget exhaustion on another scope
+    assert runner.main(["--scopes", "2n1a_r,2n1a", "--skip-lint", "-q",
+                        "--mutation", "no_wait_clear_on_reply_rd",
+                        "--max-states", "50"]) == 1
+
+
+def test_symmetry_reduction_is_sound_and_effective():
+    """The symmetric scopes must verify clean under a nontrivial
+    automorphism group, and canonicalization must actually shrink the
+    reachable graph (4n1a_sym explores its three symmetric readers
+    once, not 3! times)."""
+    from ue22cs343bb1_openmp_assignment_tpu.analysis.model_check import (
+        ModelChecker, builtin_scopes)
+    scopes = builtin_scopes()
+    ck = ModelChecker(scopes["4n1a_sym"])
+    assert len(ck._group) == 6          # S3 over the reader nodes
+    rep = ck.run()
+    assert rep["ok"], [v["name"] for v in rep["violations"]]
+    assert rep["stats"]["symmetry_group_order"] == 6
+    ck2 = ModelChecker(scopes["2n2h"])
+    assert len(ck2._group) == 2         # node swap x address swap
+    rep2 = ck2.run()
+    assert rep2["ok"], [v["name"] for v in rep2["violations"]]
+    # asymmetric scopes keep the trivial group (soundness: the node-
+    # asymmetric reference memory init admits no automorphisms)
+    assert ModelChecker(scopes["2n1a"])._group[0].is_identity
+    assert len(ModelChecker(scopes["2n1a"])._group) == 1
 
 
 # ---------------------------------------------------------------------------

@@ -1,20 +1,30 @@
-"""Static-analysis subsystem: protocol model checking + JAX trace lint.
+"""Static-analysis subsystem: exploration, fuzzing, and IR lint.
 
-Two passes, both CI-gating (``cache-sim analyze``, ``scripts/check.sh``):
+Three prongs, all surfaced by ``cache-sim analyze`` and gated in CI
+(``scripts/check.sh``):
 
-* :mod:`.model_check` — small-scope explicit-state model checker that
-  drives the real vectorized handlers (ops/handlers, ops/frontend) as a
-  transition oracle over every message interleaving of tiny
-  configurations, verifying handler coverage, the engine-tier
-  invariants everywhere, the coherence contract at every quiescent
-  state, and deadlock/livelock freedom.
-* :mod:`.lint_trace` — AST linter for the traced JAX modules (ops/,
-  parallel/, models/): Python branching on traced values, host syncs
-  and callbacks inside traced code, implicit integer dtypes, banned
-  nondeterminism sources.
+* **Exploration** — :mod:`.model_check`, a small-scope explicit-state
+  model checker that drives the real vectorized handlers (ops/handlers,
+  ops/frontend) as a transition oracle over every message interleaving
+  of tiny configurations, with node/address-permutation symmetry
+  reduction and SCC-based livelock detection; verifies handler
+  coverage, the engine-tier invariants everywhere, the coherence
+  contract at every quiescent state, and deadlock/livelock freedom,
+  rendering concrete (un-permuted) counterexample witnesses.
+* **Fuzzing** — :mod:`.fuzz`, coverage-guided differential fuzzing of
+  seeded random traces across the async/sync/native engines (coverage
+  signal from the obs/ metrics schema), and :mod:`.shrink`, ddmin
+  trace minimization emitting ready-to-run fixture repros plus
+  Perfetto traces.
+* **IR lint** — :mod:`.lint_trace`, the AST linter for the traced JAX
+  modules (ops/, parallel/, models/), and :mod:`.lint_jaxpr`, the
+  jaxpr-level audit of what XLA actually traces (64-bit widening,
+  dynamic shapes, primitive budget, host callbacks) plus the
+  three-engine recompilation guard.
 
-:mod:`.mutations` holds seeded handler bugs that the checker must
-catch (the checker's own regression suite), :mod:`.runner` the CLI.
+:mod:`.mutations` holds seeded handler bugs that the checker *and*
+fuzzer must catch (the gate's own regression suite), :mod:`.runner`
+the CLI.
 """
 
 from ue22cs343bb1_openmp_assignment_tpu.analysis.model_check import (  # noqa: F401

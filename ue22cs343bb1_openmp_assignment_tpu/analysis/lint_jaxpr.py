@@ -1,0 +1,161 @@
+"""IR-level lint: check what XLA actually traces, not Python source.
+
+The AST linter (analysis/lint_trace.py) sees source; this module runs
+``jax.make_jaxpr`` over the real hot-path entry points — ``ops.step``'s
+cycle and runners, the mailbox dequeue — and audits the closed jaxpr
+after tracing, where every decision the compiler will act on is
+explicit:
+
+* ``wide_dtype`` — no widening to 64-bit anywhere: every
+  ``convert_element_type`` target and every equation output dtype must
+  stay <= 32 bits (an accidental Python int promotion shows up here as
+  an i64 intermediate — 2x memory traffic and a slow path on TPU).
+* ``dynamic_shape`` — every output aval dimension is a concrete int;
+  a traced-in dynamic dimension means shape-polymorphic recompiles.
+* ``primitive_budget`` — the flattened equation count (recursing into
+  scan/while/cond/pjit sub-jaxprs) stays under ``EQN_BUDGET``. One
+  cycle is ~1.1k primitives nearly independent of N (the vectorized
+  design); a per-node Python loop sneaking in multiplies this by N and
+  trips the budget long before it trips a wall-clock alarm.
+* ``host_callback`` — no host round-trips (``*callback*``, infeed /
+  outfeed) inside the hot path.
+
+:func:`recompile_guard` additionally asserts repeated same-shape calls
+hit the trace cache on all three engines: fresh ``jax.jit`` wrappers
+around the async cycle and the sync round must report one cached trace
+after two calls, and the native engine's content-hash build cache must
+serve the second construction without recompiling the shared library.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.state import init_state
+
+#: flattened-primitive ceiling per linted entry point (measured ~1.1k
+#: for one cycle at reference dimensions, N in 2..8; 2048 leaves
+#: headroom for growth but catches any O(N) unrolling)
+EQN_BUDGET = 2048
+
+_WIDE = ("int64", "uint64", "float64")
+_HOST_PRIMS = ("infeed", "outfeed")
+
+
+def _subjaxprs(v):
+    vs = v if isinstance(v, (list, tuple)) else [v]
+    for s in vs:
+        if hasattr(s, "jaxpr"):        # ClosedJaxpr
+            yield s.jaxpr
+        elif hasattr(s, "eqns"):       # raw Jaxpr
+            yield s
+
+
+def _audit(jaxpr, target: str, findings: List[dict]) -> int:
+    """Walk one jaxpr (recursing into sub-jaxprs); returns the
+    flattened equation count."""
+    n = 0
+    stack = [jaxpr]
+    while stack:
+        j = stack.pop()
+        for eqn in j.eqns:
+            n += 1
+            name = eqn.primitive.name
+            if "callback" in name or name in _HOST_PRIMS:
+                findings.append({"target": target, "rule": "host_callback",
+                                 "detail": f"primitive {name!r}"})
+            nd = eqn.params.get("new_dtype")
+            if nd is not None and str(nd) in _WIDE:
+                findings.append({
+                    "target": target, "rule": "wide_dtype",
+                    "detail": f"convert_element_type -> {nd}"})
+            for var in eqn.outvars:
+                aval = var.aval
+                dt = getattr(aval, "dtype", None)
+                if dt is not None and str(dt) in _WIDE:
+                    findings.append({
+                        "target": target, "rule": "wide_dtype",
+                        "detail": f"{name} output {aval.str_short()}"})
+                for dim in getattr(aval, "shape", ()):
+                    if not isinstance(dim, int):
+                        findings.append({
+                            "target": target, "rule": "dynamic_shape",
+                            "detail": f"{name} output dim {dim!r}"})
+            for v in eqn.params.values():
+                stack.extend(_subjaxprs(v))
+    return n
+
+
+def _targets(cfg: SystemConfig) -> dict:
+    from ue22cs343bb1_openmp_assignment_tpu.ops import mailbox, step
+    return {
+        "step.cycle": lambda s: step.cycle(cfg, s),
+        "mailbox.dequeue": lambda s: mailbox.dequeue(cfg, s),
+        "step.run_cycles[8]": lambda s: step.run_cycles(cfg, s, 8),
+        "step.run_to_quiescence":
+            lambda s: step.run_to_quiescence(cfg, s, 64),
+    }
+
+
+def lint(cfg: Optional[SystemConfig] = None,
+         message_phase: Optional[Callable] = None) -> dict:
+    """Trace and audit every hot-path target; returns {targets:
+    {name: eqn_count}, findings: [...], budget, ok}."""
+    cfg = cfg or SystemConfig.reference()
+    st = init_state(cfg, [[(0, 1, 0)]] * cfg.num_nodes)
+    findings: List[dict] = []
+    counts = {}
+    for name, fn in _targets(cfg).items():
+        closed = jax.make_jaxpr(fn)(st)
+        counts[name] = _audit(closed.jaxpr, name, findings)
+        if counts[name] > EQN_BUDGET:
+            findings.append({
+                "target": name, "rule": "primitive_budget",
+                "detail": f"{counts[name]} eqns > budget {EQN_BUDGET}"})
+    return {"schema": "cache-sim/jaxpr-lint/v1",
+            "num_nodes": cfg.num_nodes, "budget": EQN_BUDGET,
+            "targets": counts, "findings": findings,
+            "ok": not findings}
+
+
+def recompile_guard(cfg: Optional[SystemConfig] = None) -> dict:
+    """Two same-shape calls per engine must compile exactly once."""
+    import os
+
+    from ue22cs343bb1_openmp_assignment_tpu.native import bindings
+    from ue22cs343bb1_openmp_assignment_tpu.ops import step
+    from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+
+    cfg = cfg or SystemConfig.reference(num_nodes=2)
+    traces = [[(1, 1, 7)], [(0, 1, 0)]][:cfg.num_nodes]
+    traces += [[(0, 1, 0)]] * (cfg.num_nodes - len(traces))
+
+    f_async = jax.jit(lambda s: step.cycle(cfg, s))
+    st = init_state(cfg, traces)
+    f_async(st)
+    f_async(st)
+    a = f_async._cache_size()
+
+    f_sync = jax.jit(lambda s: se.round_step(cfg, s))
+    ss = se.from_sim_state(cfg, init_state(cfg, traces))
+    f_sync(ss)
+    f_sync(ss)
+    s = f_sync._cache_size()
+
+    # the native build cache is content-hash keyed: a second engine
+    # must reuse the compiled library byte-for-byte (same path, no
+    # rebuild — the mtime would move if the .so were recompiled)
+    eng1 = bindings.NativeEngine(cfg)
+    path = bindings._lib_path()
+    mtime = os.path.getmtime(path)
+    eng2 = bindings.NativeEngine(cfg)
+    n_ok = (bindings._lib_path() == path
+            and os.path.getmtime(path) == mtime)
+    del eng1, eng2
+
+    return {"async_cache_size": a, "sync_cache_size": s,
+            "native_build_reused": bool(n_ok),
+            "ok": a == 1 and s == 1 and bool(n_ok)}

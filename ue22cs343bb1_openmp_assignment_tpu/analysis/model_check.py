@@ -37,7 +37,25 @@ exactly one node moves per oracle call.
   (SURVEY §2: the protocol tracks no INV-acks, so a racing fill can
   legally strand a stale copy); everything else is a genuine violation.
 * *progress* — no deadlock (terminal state with a blocked node) and no
-  livelock (reachable state from which no terminal state is reachable).
+  livelock: Tarjan SCCs of the reachable graph, flagging every strongly
+  connected component with no path to a terminal state and rendering a
+  lasso witness (stem + the message cycle itself).
+
+**Symmetry reduction (Murφ-style, Ip & Dill).** Node ids and memory
+blocks are scalarsets: any node permutation σ (composed with a
+cache-index-preserving block permutation β) that maps the per-node
+programs and the initial state onto themselves is an automorphism of
+the transition graph — the vectorized handlers only ever compare node
+ids for equality (home/second/sender tests, bit masks) and never order
+them, except ``ctz`` owner selection, which on reachable states is
+applied to singleton sharer sets only (the `em_not_single_owner`
+invariant) and therefore commutes with σ. The checker computes this
+automorphism group once per scope, then stores only the lexicographic
+minimum of each successor's orbit; counterexample paths un-permute
+each edge on the way out so rendered witnesses are concrete runs.
+Scopes whose programs need symmetric initial memory opt in via
+``Scope(mem_uniform=True)`` (the reference's ``20*t + i`` pattern is
+node-asymmetric and would collapse the group to the identity).
 
 Reports are machine-readable dicts (JSON-stable ordering) with
 counterexample paths from the initial state; analysis/runner.py renders
@@ -48,6 +66,7 @@ this checker must catch — its regression suite.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 
 import jax
 import numpy as np
@@ -113,6 +132,10 @@ class Scope:
     name: str
     cfg: SystemConfig
     programs: tuple  # per node: tuple of (Op, addr, value)
+    # node-symmetric initial memory (block i of every node starts i&0xFF)
+    # instead of the reference's node-asymmetric 20*t+i pattern — required
+    # for any scope that wants a nontrivial symmetry group
+    mem_uniform: bool = False
 
     def __post_init__(self):
         if self.cfg.bitvec_words != 1 or self.cfg.msg_bitvec_words != 1:
@@ -131,6 +154,7 @@ class Scope:
             "num_nodes": self.cfg.num_nodes,
             "cache_size": self.cfg.cache_size,
             "mem_size": self.cfg.mem_size,
+            "mem_init": "uniform" if self.mem_uniform else "reference",
             "programs": [[[Op(op).name, int(a), int(v)] for op, a, v in p]
                          for p in self.programs],
         }
@@ -152,6 +176,18 @@ def builtin_scopes() -> dict:
       unconditionally, ``assignment.c:322,535``), so write traffic
       rescues a stranded reader; with reads only, every reply must do
       its own unblocking or the checker sees a deadlock.
+    * ``4n1a_sym`` — 4 nodes, one address, one writer racing THREE
+      readers: deeper REPLY_ID fan-out, three-way unacked-INV races,
+      multi-sharer EVICT promotion chains. Only tractable under the
+      state cap because the three readers are interchangeable: the
+      S3 automorphism group over nodes {1,2,3} (order 6) folds their
+      interleavings into one orbit representative each.
+    * ``2n2h`` — 2 nodes, TWO homed addresses (one per node), each
+      node writing the remote-homed block then reading its own: both
+      directories active at once, crossing request/reply traffic,
+      write-miss-on-remote + read-after-invalidate on every
+      interleaving. The swap (σ=(01) with the two addresses exchanged)
+      is an automorphism — the scope is checked modulo that mirror.
     """
     cfg2 = SystemConfig(num_nodes=2, cache_size=1, mem_size=2,
                         queue_capacity=16, max_instrs=4, inv_mode="mailbox")
@@ -161,6 +197,9 @@ def builtin_scopes() -> dict:
     cfg3 = SystemConfig(num_nodes=3, cache_size=1, mem_size=2,
                         queue_capacity=16, max_instrs=4, inv_mode="mailbox")
     a3 = codec.make_address(cfg3, 0, 0)
+    cfg4 = SystemConfig(num_nodes=4, cache_size=1, mem_size=2,
+                        queue_capacity=16, max_instrs=4, inv_mode="mailbox")
+    a4 = codec.make_address(cfg4, 0, 0)
     R, W = int(Op.READ), int(Op.WRITE)
     scopes = [
         Scope("2n1a", cfg2, (
@@ -180,6 +219,16 @@ def builtin_scopes() -> dict:
             ((R, r, 0),),
             ((R, r, 0),),
         )),
+        Scope("4n1a_sym", cfg4, (
+            ((W, a4, 5),),
+            ((R, a4, 0),),
+            ((R, a4, 0),),
+            ((R, a4, 0),),
+        ), mem_uniform=True),
+        Scope("2n2h", cfg2, (
+            ((W, r, 5), (R, a, 0)),
+            ((W, a, 5), (R, r, 0)),
+        ), mem_uniform=True),
     ]
     return {s.name: s for s in scopes}
 
@@ -233,6 +282,144 @@ def enabled_events(scope: Scope, a: AState) -> list:
     return evs
 
 
+# ---------------------------------------------------------------------------
+# symmetry: node/address permutation automorphisms
+# ---------------------------------------------------------------------------
+
+# message types whose `second` field carries a live node id (the
+# original requester); every other handler leaves/reads it as literal 0
+# (handlers.py pri_second/sec_second selects), so permuting a dead field
+# would fabricate states the engine never produces
+_SECOND_LIVE = frozenset((int(Msg.WRITEBACK_INT), int(Msg.WRITEBACK_INV),
+                          int(Msg.FLUSH), int(Msg.FLUSH_INVACK)))
+
+
+@dataclasses.dataclass(frozen=True)
+class _Perm:
+    """One automorphism: node permutation σ + block permutation β.
+
+    ``amap`` is the induced address map (home(addr) through σ, block
+    through β); β is constrained to preserve cache_index so a line
+    never changes its direct-mapped slot under the action.
+    """
+
+    sig: tuple        # σ[n] = image of node n
+    inv_sig: tuple
+    beta: tuple       # β[b] = image of block b
+    inv_beta: tuple
+    amap: tuple       # addr -> addr over all (home, block) addresses
+    bvmap: tuple      # sharer-bitvector word -> permuted word (2^N entries)
+
+    @property
+    def is_identity(self) -> bool:
+        return (self.sig == tuple(range(len(self.sig)))
+                and self.beta == tuple(range(len(self.beta))))
+
+
+def _make_perm(cfg: SystemConfig, sig, beta) -> _Perm:
+    N, M = cfg.num_nodes, cfg.mem_size
+    inv_sig = [0] * N
+    for n, j in enumerate(sig):
+        inv_sig[j] = n
+    inv_beta = [0] * M
+    for b, j in enumerate(beta):
+        inv_beta[j] = b
+    amap = [0] * (N << cfg.block_bits)
+    for h in range(N):
+        for b in range(M):
+            src = codec.make_address(cfg, h, b)
+            amap[src] = codec.make_address(cfg, sig[h], beta[b])
+    bvmap = []
+    for w in range(1 << N):
+        out = 0
+        for n in range(N):
+            if (w >> n) & 1:
+                out |= 1 << sig[n]
+        bvmap.append(out)
+    return _Perm(tuple(sig), tuple(inv_sig), tuple(beta), tuple(inv_beta),
+                 tuple(amap), tuple(bvmap))
+
+
+def _apply_perm(cfg: SystemConfig, g: _Perm, a: AState) -> AState:
+    """The group action on abstract states: relabel every node-id- and
+    address-valued field; permute rows by σ and block columns by β."""
+    if g.is_identity:
+        return a
+    N, C, M = cfg.num_nodes, cfg.cache_size, cfg.mem_size
+    n_addr = len(g.amap)
+
+    def ra(addr):  # remap a (possibly sentinel) address value
+        return g.amap[addr] if 0 <= addr < n_addr else addr
+
+    cache_addr, cache_val, cache_state = [], [], []
+    memory, dir_state, dir_bitvec = [], [], []
+    instr_idx, waiting = [], []
+    cur_op, cur_addr, cur_val, queues = [], [], [], []
+    for j in range(N):
+        n = g.inv_sig[j]
+        cache_addr.append(tuple(ra(a.cache_addr[n][c]) for c in range(C)))
+        cache_val.append(a.cache_val[n])
+        cache_state.append(a.cache_state[n])
+        memory.append(tuple(a.memory[n][g.inv_beta[b]] for b in range(M)))
+        dir_state.append(tuple(a.dir_state[n][g.inv_beta[b]]
+                               for b in range(M)))
+        dir_bitvec.append(tuple(g.bvmap[a.dir_bitvec[n][g.inv_beta[b]]]
+                                for b in range(M)))
+        instr_idx.append(a.instr_idx[n])
+        waiting.append(a.waiting[n])
+        cur_op.append(a.cur_op[n])
+        cur_val.append(a.cur_val[n])
+        # a never-fetched node's latch is identically (0, 0, 0) — its
+        # fields are dead until the first fetch overwrites them, so
+        # remapping would fabricate unreachable states
+        cur_addr.append(ra(a.cur_addr[n]) if a.instr_idx[n] >= 0
+                        else a.cur_addr[n])
+        queues.append(tuple(
+            (t, g.sig[s], ra(ad), val,
+             g.sig[sec] if t in _SECOND_LIVE else sec, ds, g.bvmap[bv])
+            for (t, s, ad, val, sec, ds, bv) in a.queues[n]))
+    return AState(
+        cache_addr=tuple(cache_addr), cache_val=tuple(cache_val),
+        cache_state=tuple(cache_state), memory=tuple(memory),
+        dir_state=tuple(dir_state), dir_bitvec=tuple(dir_bitvec),
+        instr_idx=tuple(instr_idx), waiting=tuple(waiting),
+        cur_op=tuple(cur_op), cur_addr=tuple(cur_addr),
+        cur_val=tuple(cur_val), queues=tuple(queues))
+
+
+def _akey(a: AState) -> tuple:
+    """Total order over AStates for orbit canonicalization."""
+    return (a.cache_addr, a.cache_val, a.cache_state, a.memory,
+            a.dir_state, a.dir_bitvec, a.instr_idx, a.waiting,
+            a.cur_op, a.cur_addr, a.cur_val, a.queues)
+
+
+def symmetry_group(scope: Scope, a0: AState) -> list:
+    """All (σ, β) automorphisms of the scope: β preserves cache_index,
+    the per-node programs map onto each other (programs[σ[n]] equals
+    node n's program with every address pushed through the induced
+    amap), and the initial state is a fixed point. Identity first."""
+    cfg = scope.cfg
+    N, M, C = cfg.num_nodes, cfg.mem_size, cfg.cache_size
+    out = []
+    block_perms = [p for p in itertools.permutations(range(M))
+                   if all(p[b] % C == b % C for b in range(M))]
+    if len(block_perms) > 64:          # scalarset guard for huge scopes
+        block_perms = [tuple(range(M))]
+    for sig in itertools.permutations(range(N)):
+        for beta in block_perms:
+            g = _make_perm(cfg, sig, beta)
+            if any(tuple((op, g.amap[ad], v) for op, ad, v in
+                         scope.programs[n]) != scope.programs[sig[n]]
+                   for n in range(N)):
+                continue
+            if _apply_perm(cfg, g, a0) != a0:
+                continue
+            out.append(g)
+    out.sort(key=lambda g: (not g.is_identity, g.sig, g.beta))
+    return out
+
+
 class ModelChecker:
     """Explicit-state BFS over one scope's reachable graph.
 
@@ -278,6 +465,40 @@ class ModelChecker:
         self._instr_arrays = self._build_instr_arrays()
         self._fault_key = np.asarray(
             jax.device_get(init_state(cfg).fault_key), np.uint32)
+        self._a0 = self._initial()
+        self._build_sym(self._a0)
+
+    # -- symmetry ----------------------------------------------------------
+
+    def _build_sym(self, a0: AState) -> None:
+        """Automorphism group + composition/inverse tables (group order
+        is tiny — ≤ |S_N| on these scopes — so dense tables are free)."""
+        cfg = self.cfg
+        self._group = symmetry_group(self.scope, a0)
+        G = len(self._group)
+        idx = {(g.sig, g.beta): i for i, g in enumerate(self._group)}
+        self._mul = [[0] * G for _ in range(G)]   # mul[i][j] = g_i ∘ g_j
+        self._ginv = [0] * G
+        for i, gi in enumerate(self._group):
+            for j, gj in enumerate(self._group):
+                sig = tuple(gi.sig[s] for s in gj.sig)
+                beta = tuple(gi.beta[b] for b in gj.beta)
+                k = idx[(sig, beta)]
+                self._mul[i][j] = k
+                if k == 0:
+                    self._ginv[i] = j
+
+    def _canon(self, a: AState):
+        """(orbit representative, index of the g with g·a = canon)."""
+        if len(self._group) == 1:
+            return a, 0
+        best, bk, bi = a, _akey(a), 0
+        for i in range(1, len(self._group)):
+            p = _apply_perm(self.cfg, self._group[i], a)
+            k = _akey(p)
+            if k < bk:
+                best, bk, bi = p, k, i
+        return best, bi
 
     # -- staging: AState -> concrete SimState (numpy leaves) --------------
 
@@ -399,9 +620,14 @@ class ModelChecker:
         st = jax.device_get(
             init_state(self.cfg, traces=[list(p) for p in
                                          self.scope.programs]))
+        memory = _t2(st.memory)
+        if self.scope.mem_uniform:
+            memory = tuple(
+                tuple(i & 0xFF for i in range(self.cfg.mem_size))
+                for _ in range(self.cfg.num_nodes))
         return AState(
             cache_addr=_t2(st.cache_addr), cache_val=_t2(st.cache_val),
-            cache_state=_t2(st.cache_state), memory=_t2(st.memory),
+            cache_state=_t2(st.cache_state), memory=memory,
             dir_state=_t2(st.dir_state),
             dir_bitvec=_t2(st.dir_bitvec[..., 0]),
             instr_idx=_t1(st.instr_idx),
@@ -483,10 +709,10 @@ class ModelChecker:
 
     def run(self) -> dict:
         scope, cfg = self.scope, self.cfg
-        a0 = self._initial()
+        a0 = self._a0            # group-invariant, so already canonical
         ids = {a0: 0}
         states = [a0]
-        parent = [None]          # per id: (pred_id, event) or None
+        parent = [None]          # per id: (pred_id, event, perm_idx) | None
         adj = [[]]               # per id: list of (event, dst_id)
         terminals = []
         engaged_pairs = {}       # pair -> [count, first_state_id]
@@ -513,6 +739,7 @@ class ModelChecker:
                 for j, (sid, ev) in enumerate(chunk):
                     new_a, dropped, ovf = self._read_back(
                         states[sid], ev, res, j)
+                    new_a, gi = self._canon(new_a)
                     if dropped or ovf:
                         violations.append({
                             "check": "scope_overflow",
@@ -536,7 +763,7 @@ class ModelChecker:
                         nid = len(states)
                         ids[new_a] = nid
                         states.append(new_a)
-                        parent.append((sid, ev))
+                        parent.append((sid, ev, gi))
                         adj.append([])
                         nxt.append(nid)
                         if nid >= self.max_states:
@@ -554,27 +781,61 @@ class ModelChecker:
             else:
                 quiescent_terms.append(sid)
         for sid in deadlocks:
+            path, fin = self._trace_to(parent, states, sid)
             violations.append({
                 "check": "deadlock",
                 "name": "deadlock",
                 "detail": "terminal state with a blocked node (a reply "
                           "was lost or never clears `waiting`)",
                 "state": sid,
-                "path": self.path_to(parent, states, sid),
-                "state_render": self.render_state(states[sid])})
+                "path": path,
+                "state_render": self.render_state(fin)})
 
-        can_finish = self._backward_reach(adj, terminals)
-        stuck = [sid for sid in range(len(states)) if not can_finish[sid]]
-        if stuck:
-            sid = stuck[0]
+        # livelock: Tarjan SCCs of the reachable graph; every component
+        # with no path to a terminal is a genuine non-progress trap, and
+        # a cycle inside it is the lasso witness. (Tarjan emits SCCs in
+        # reverse topological order of the condensation, so one forward
+        # pass over the emission order resolves can-reach-terminal.)
+        comp_id, comps = self._sccs(adj)
+        is_term = [False] * len(states)
+        for t in terminals:
+            is_term[t] = True
+        comp_can = [False] * len(comps)
+        for ci, members in enumerate(comps):
+            ok = any(is_term[v] for v in members)
+            if not ok:
+                ok = any(comp_can[comp_id[d]]
+                         for v in members for _, d in adj[v]
+                         if comp_id[d] != ci)
+            comp_can[ci] = ok
+        stuck_comps = [ci for ci in range(len(comps)) if not comp_can[ci]]
+        if stuck_comps:
+            n_stuck = sum(len(comps[ci]) for ci in stuck_comps)
+            # witness: a stuck SCC that contains a cycle (a stuck state
+            # always leads into one — the graph is finite)
+            wit = next(
+                (ci for ci in stuck_comps
+                 if len(comps[ci]) > 1
+                 or any(d == comps[ci][0] for _, d in adj[comps[ci][0]])),
+                stuck_comps[0])
+            cyc = self._cycle_in(adj, comp_id, wit, comps[wit][0])
+            entry = cyc[0][0] if cyc else comps[wit][0]
+            path, fin = self._trace_to(parent, states, entry)
+            mod = (" (cycle shown modulo node/address relabeling)"
+                   if len(self._group) > 1 else "")
             violations.append({
                 "check": "livelock",
                 "name": "livelock",
-                "detail": f"{len(stuck)} reachable states cannot reach "
-                          "any terminal state (message cycle)",
-                "state": sid,
-                "path": self.path_to(parent, states, sid),
-                "state_render": self.render_state(states[sid])})
+                "detail": f"{n_stuck} reachable states in "
+                          f"{len(stuck_comps)} SCCs cannot reach any "
+                          f"terminal state; lasso witness: stem of "
+                          f"{len(path)} events + a {len(cyc)}-event "
+                          f"message cycle{mod}",
+                "state": entry,
+                "path": path,
+                "cycle": [self._render_event(states[s], ev)
+                          for s, ev in cyc],
+                "state_render": self.render_state(fin)})
 
         # ---- handler coverage --------------------------------------------
         sanctioned_noops = []
@@ -586,6 +847,7 @@ class ModelChecker:
                     "pair": self._pair_str(pair), "count": count,
                     "rationale": why})
             else:
+                path, fin = self._trace_to(parent, states, sid)
                 violations.append({
                     "check": "unhandled_pair",
                     "name": "unhandled_pair",
@@ -593,8 +855,8 @@ class ModelChecker:
                               f"{self._pair_str(pair)} "
                               f"({count} occurrences)",
                     "state": sid,
-                    "path": self.path_to(parent, states, sid),
-                    "state_render": self.render_state(states[sid])})
+                    "path": path,
+                    "state_render": self.render_state(fin)})
 
         # ---- engine-tier invariants on EVERY reachable state -------------
         step_names = list(invariants.step_violations(
@@ -612,12 +874,13 @@ class ModelChecker:
                         step_hits[name] = start + j
         for name in sorted(step_hits):
             sid = step_hits[name]
+            path, fin = self._trace_to(parent, states, sid)
             violations.append({
                 "check": "step_invariant", "name": name, "state": sid,
                 "detail": f"engine-tier invariant `{name}` violated on a "
                           "reachable state",
-                "path": self.path_to(parent, states, sid),
-                "state_render": self.render_state(states[sid])})
+                "path": path,
+                "state_render": self.render_state(fin)})
 
         # ---- coherence tier at quiescent terminals -----------------------
         quirks, quiet_hits = {}, {}
@@ -639,12 +902,13 @@ class ModelChecker:
                         quiet_hits[name] = sid
         for name in sorted(quiet_hits):
             sid = quiet_hits[name]
+            path, fin = self._trace_to(parent, states, sid)
             violations.append({
                 "check": "coherence", "name": name, "state": sid,
                 "detail": f"coherence contract `{name}` violated at a "
                           "quiescent state (not a sanctioned quirk)",
-                "path": self.path_to(parent, states, sid),
-                "state_render": self.render_state(states[sid])})
+                "path": path,
+                "state_render": self.render_state(fin)})
 
         violations.sort(key=lambda v: (v["check"], v.get("name", ""),
                                        v["state"]))
@@ -658,6 +922,8 @@ class ModelChecker:
                 "terminal_states": len(terminals),
                 "quiescent_states": len(quiescent_terms),
                 "deadlocked_states": len(deadlocks),
+                "symmetry_group_order": len(self._group),
+                "sccs": len(comps),
             },
             "coverage": {
                 "engaged_pairs": sorted(
@@ -676,34 +942,104 @@ class ModelChecker:
         }
         return report
 
+    def _trace_to(self, parent, states, sid):
+        """(rendered concrete event path from the initial state, the
+        concrete final AState the path actually lands in).
+
+        Stored states are orbit representatives: edge k records the
+        permutation π_k with canon = π_k·(raw successor). Unwinding with
+        the accumulated h_k = π_k∘h_{k-1} (concrete state t_k =
+        h_k⁻¹·c_k, concrete event f_k = h_{k-1}⁻¹·e_k) turns the
+        quotient path back into one genuine run of the machine."""
+        edges = []
+        while parent[sid] is not None:
+            pid, ev, gi = parent[sid]
+            edges.append((pid, ev, gi))
+            sid = pid
+        edges.reverse()
+        out, h = [], 0
+        for pid, ev, gi in edges:
+            hin = self._group[self._ginv[h]]
+            src = _apply_perm(self.cfg, hin, states[pid])
+            out.append(self._render_event(src, (ev[0], hin.sig[ev[1]])))
+            h = self._mul[gi][h]
+        final = _apply_perm(self.cfg, self._group[self._ginv[h]],
+                            states[sid])
+        return out, final
+
     def path_to(self, parent, states, sid) -> list:
         """Counterexample path: rendered events from the initial state."""
-        chain = []
-        while parent[sid] is not None:
-            pid, ev = parent[sid]
-            chain.append(self._render_event(states[pid], ev))
-            sid = pid
-        return list(reversed(chain))
+        return self._trace_to(parent, states, sid)[0]
 
     @staticmethod
-    def _backward_reach(adj, seeds):
-        """Which states can reach a seed (terminal) state?"""
+    def _sccs(adj):
+        """Iterative Tarjan: (comp_id per state, components in emission
+        order — reverse topological order of the condensation)."""
         n = len(adj)
-        rev = [[] for _ in range(n)]
-        for src, out in enumerate(adj):
-            for _, dst in out:
-                rev[dst].append(src)
-        seen = [False] * n
-        stack = list(seeds)
-        for s in seeds:
-            seen[s] = True
-        while stack:
-            v = stack.pop()
-            for u in rev[v]:
-                if not seen[u]:
-                    seen[u] = True
-                    stack.append(u)
-        return seen
+        index = [-1] * n
+        low = [0] * n
+        on = [False] * n
+        stack: list = []
+        comps: list = []
+        comp_id = [-1] * n
+        counter = 0
+        for root in range(n):
+            if index[root] != -1:
+                continue
+            work = [(root, 0)]
+            while work:
+                v, pi = work[-1]
+                if pi == 0:
+                    index[v] = low[v] = counter
+                    counter += 1
+                    stack.append(v)
+                    on[v] = True
+                descended = False
+                out = adj[v]
+                for i in range(pi, len(out)):
+                    w = out[i][1]
+                    if index[w] == -1:
+                        work[-1] = (v, i + 1)
+                        work.append((w, 0))
+                        descended = True
+                        break
+                    if on[w]:
+                        low[v] = min(low[v], index[w])
+                if descended:
+                    continue
+                work.pop()
+                if work:
+                    u = work[-1][0]
+                    low[u] = min(low[u], low[v])
+                if low[v] == index[v]:
+                    members = []
+                    while True:
+                        w = stack.pop()
+                        on[w] = False
+                        comp_id[w] = len(comps)
+                        members.append(w)
+                        if w == v:
+                            break
+                    comps.append(members)
+        return comp_id, comps
+
+    @staticmethod
+    def _cycle_in(adj, comp_id, ci, v0) -> list:
+        """A cycle inside SCC `ci` starting the walk at v0: list of
+        (state_id, event) edges. Every vertex of a stuck SCC has an
+        in-component out-edge, so the greedy walk must revisit."""
+        path: list = []
+        pos: dict = {}
+        v = v0
+        while v not in pos:
+            pos[v] = len(path)
+            step_edge = next(((e, d) for e, d in adj[v]
+                              if comp_id[d] == ci), None)
+            if step_edge is None:      # trivial SCC without a self-loop
+                return []
+            path.append((v, step_edge[0]))
+            v = step_edge[1]
+        return path[pos[v]:]
 
 
 def check_scope(scope: Scope, message_phase=None,

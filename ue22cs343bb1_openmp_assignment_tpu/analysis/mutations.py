@@ -19,7 +19,8 @@ import jax.numpy as jnp
 
 from ue22cs343bb1_openmp_assignment_tpu import codec
 from ue22cs343bb1_openmp_assignment_tpu.ops import handlers
-from ue22cs343bb1_openmp_assignment_tpu.types import Msg
+from ue22cs343bb1_openmp_assignment_tpu.state import bit_single
+from ue22cs343bb1_openmp_assignment_tpu.types import DirState, Msg
 
 
 def _is(mv, ty):
@@ -90,6 +91,54 @@ def drop_evict_modified(cfg, state, mv):
     return upd, cand, inv, stats
 
 
+def stale_owner_forward(cfg, state, mv):
+    """READ_REQUEST on a dirty (EM) line replies straight from memory
+    instead of forwarding WRITEBACK_INT to the owner (the reference
+    forwards at ``assignment.c:277-286``), and registers the requester
+    as a sharer while the directory still says EM. Expected:
+    `em_not_single_owner` — two presence bits under an EM entry."""
+    upd, cand, inv, stats = handlers.message_phase(cfg, state, mv)
+    rows = jnp.arange(cfg.num_nodes, dtype=jnp.int32)
+    p_block = codec.block_index(cfg, mv.addr)
+    dirst = state.dir_state[rows, p_block]
+    dirbv = state.dir_bitvec[rows, p_block]
+    memv = state.memory[rows, p_block]
+    rr_em = (_is(mv, Msg.READ_REQUEST)
+             & (rows == codec.home_node(cfg, mv.addr))
+             & (dirst == int(DirState.EM)))
+    ty, recv, ad, val, sec, ds, bv = cand["pri"]
+    cand = dict(cand, pri=(
+        jnp.where(rr_em, int(Msg.REPLY_RD), ty),
+        jnp.where(rr_em, mv.sender, recv), ad,
+        jnp.where(rr_em, memv, val),
+        jnp.where(rr_em, 0, sec), ds, bv))
+    m, i, v = upd["dir_bv"]
+    sender_bit = bit_single(cfg.bitvec_words, mv.sender)
+    upd = dict(upd, dir_bv=(
+        m | rr_em, i,
+        jnp.where(rr_em[:, None], dirbv | sender_bit, v)))
+    return upd, cand, inv, stats
+
+
+def evict_shared_keeps_bit(cfg, state, mv):
+    """EVICT_SHARED at the home updates the directory state but never
+    clears the evictor's presence bit (the reference drops it at
+    ``assignment.c:566``) — the sharer-count decrement is lost, like a
+    dropped invalidation ack. Expected: `unowned_with_sharers` when the
+    last sharer leaves (U entry with bits set) or
+    `em_not_single_owner` when the survivor is promoted."""
+    upd, cand, inv, stats = handlers.message_phase(cfg, state, mv)
+    rows = jnp.arange(cfg.num_nodes, dtype=jnp.int32)
+    p_block = codec.block_index(cfg, mv.addr)
+    dirbv = state.dir_bitvec[rows, p_block]
+    es_home = (_is(mv, Msg.EVICT_SHARED)
+               & (rows == codec.home_node(cfg, mv.addr)))
+    m, i, v = upd["dir_bv"]
+    upd = dict(upd, dir_bv=(
+        m, i, jnp.where(es_home[:, None], dirbv, v)))
+    return upd, cand, inv, stats
+
+
 # name -> (wrapper, scope that exposes it, finding the checker must raise)
 MUTATIONS = {
     "skip_em_bitvec_clear": (skip_em_bitvec_clear, "2n2a",
@@ -100,4 +149,8 @@ MUTATIONS = {
                                   "deadlock"),
     "drop_evict_modified": (drop_evict_modified, "2n2a",
                             "unhandled_pair"),
+    "stale_owner_forward": (stale_owner_forward, "2n1a",
+                            "em_not_single_owner"),
+    "evict_shared_keeps_bit": (evict_shared_keeps_bit, "2n2a",
+                               "unowned_with_sharers"),
 }

@@ -1,12 +1,15 @@
 """`cache-sim analyze` — the static-analysis gate (host-side CLI).
 
-Runs the protocol model checker over the builtin small scopes and the
-JAX trace linter over the traced packages, prints a human report that
-keeps reference-sanctioned quirks (`~`) visually distinct from genuine
-violations (`!`), optionally writes the full JSON report, and exits
-nonzero iff anything genuinely failed. This is the CI entry point
-(scripts/check.sh); `python -m ue22cs343bb1_openmp_assignment_tpu.analysis`
-is the same thing.
+Runs the three verification prongs: the symmetry-reduced protocol model
+checker over the builtin small scopes, the linters (AST trace lint
+always; jaxpr IR lint + recompilation guard behind ``--jaxpr``), and
+the coverage-guided differential fuzzer behind ``--fuzz N``. Prints a
+human report that keeps reference-sanctioned quirks (`~`) visually
+distinct from genuine violations (`!`), optionally writes the full
+JSON report, and exits by the code table in ``--help``. This is the CI
+entry point (scripts/check.sh);
+`python -m ue22cs343bb1_openmp_assignment_tpu.analysis` is the same
+thing.
 """
 
 from __future__ import annotations
@@ -15,13 +18,26 @@ import argparse
 import json
 import sys
 
+_EPILOG = """\
+exit codes:
+  0  clean pass — every requested check ran to completion and passed
+  1  findings — a protocol violation, lint finding, fuzz divergence,
+     or failed recompilation guard
+  3  budget exhausted, no finding — a scope hit --max-states before
+     exhausting its state space: nothing failed, but nothing was
+     proven either; raise --max-states or shrink the scope
+(2 is argparse's usage-error code, left untouched)"""
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="cache-sim analyze",
-        description="Statically verify the coherence engine: small-scope "
-                    "protocol model checking + JAX trace lint.")
-    p.add_argument("--scopes", default=None,
+        description="Statically verify the coherence engine: "
+                    "symmetry-reduced protocol model checking, "
+                    "AST + jaxpr lint, differential fuzzing.",
+        epilog=_EPILOG,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--scopes", "--scope", dest="scopes", default=None,
                    help="comma-separated scope names (default: all "
                         "builtin scopes)")
     p.add_argument("--list-scopes", action="store_true",
@@ -29,11 +45,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--skip-model-check", action="store_true")
     p.add_argument("--skip-lint", action="store_true")
     p.add_argument("--mutation", default=None,
-                   help="run the model checker with this seeded handler "
-                        "bug from analysis.mutations (the checker must "
+                   help="run the checker/fuzzer with this seeded handler "
+                        "bug from analysis.mutations (the gate must "
                         "fail — its own regression test)")
     p.add_argument("--max-states", type=int, default=50_000,
-                   help="state-count guard per scope (default 50000)")
+                   help="state-count guard per scope (default 50000); "
+                        "exceeding it without a finding exits 3")
+    p.add_argument("--fuzz", type=int, default=0, metavar="N",
+                   help="run N coverage-guided differential fuzz cases "
+                        "(async vs native on any traffic, sync joining "
+                        "on node-local); diverging traces are ddmin-"
+                        "shrunk automatically")
+    p.add_argument("--seed", type=int, default=0,
+                   help="fuzzer PRNG seed (default 0); the seed fully "
+                        "determines corpus and verdicts")
+    p.add_argument("--repro-dir", default=None, metavar="DIR",
+                   help="write shrunk fuzz repros here (core_<n>.txt "
+                        "fixture + repro.json + Perfetto trace)")
+    p.add_argument("--jaxpr", action="store_true",
+                   help="run the jaxpr IR lint over the ops/ hot paths "
+                        "plus the three-engine recompilation guard")
     p.add_argument("--json", dest="json_path", default=None,
                    help="write the full JSON report here")
     p.add_argument("--lint-paths", nargs="*", default=None,
@@ -49,6 +80,16 @@ def _print(quiet: bool, *a) -> None:
         print(*a)
 
 
+def _resolve_mutation(name):
+    if name is None:
+        return None, None, None
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import mutations
+    if name not in mutations.MUTATIONS:
+        raise SystemExit(f"unknown mutation `{name}` "
+                         f"(have: {', '.join(mutations.MUTATIONS)})")
+    return mutations.MUTATIONS[name]
+
+
 def run_model_check(scope_names, mutation, max_states, quiet) -> dict:
     from ue22cs343bb1_openmp_assignment_tpu.analysis import model_check
     scopes = model_check.builtin_scopes()
@@ -59,15 +100,8 @@ def run_model_check(scope_names, mutation, max_states, quiet) -> dict:
         raise SystemExit(f"unknown scope(s): {', '.join(unknown)} "
                          f"(have: {', '.join(scopes)})")
 
-    mp = None
-    if mutation is not None:
-        from ue22cs343bb1_openmp_assignment_tpu.analysis import mutations
-        if mutation not in mutations.MUTATIONS:
-            raise SystemExit(
-                f"unknown mutation `{mutation}` "
-                f"(have: {', '.join(mutations.MUTATIONS)})")
-        fn, mscope, expected = mutations.MUTATIONS[mutation]
-        mp = fn
+    mp, mscope, expected = _resolve_mutation(mutation)
+    if mp is not None:
         if scope_names is None:
             names = [mscope]
         _print(quiet, f"== seeded mutation `{mutation}` on scope "
@@ -75,8 +109,15 @@ def run_model_check(scope_names, mutation, max_states, quiet) -> dict:
 
     out = {}
     for name in names:
-        rep = model_check.check_scope(scopes[name], message_phase=mp,
-                                      max_states=max_states)
+        try:
+            rep = model_check.check_scope(scopes[name], message_phase=mp,
+                                          max_states=max_states)
+        except model_check.ScopeTooLarge as e:
+            out[name] = {"ok": None, "budget_exhausted": True,
+                         "detail": str(e)}
+            _print(quiet, f"== scope {name}: BUDGET EXHAUSTED ({e}) — "
+                          "no finding; not a pass")
+            continue
         out[name] = rep
         st = rep["stats"]
         verdict = "ok" if rep["ok"] else "FAIL"
@@ -84,7 +125,8 @@ def run_model_check(scope_names, mutation, max_states, quiet) -> dict:
                f"== scope {name}: {verdict}  "
                f"[{st['states']} states, {st['transitions']} transitions, "
                f"{st['quiescent_states']} quiescent, "
-               f"{st['deadlocked_states']} deadlocked]")
+               f"{st['deadlocked_states']} deadlocked, "
+               f"sym x{st['symmetry_group_order']}]")
         for q in rep["quirks"]:
             _print(quiet, f"  ~ {q['name']} ({q['states']} states) — "
                           f"sanctioned: {q['rationale']}")
@@ -97,6 +139,8 @@ def run_model_check(scope_names, mutation, max_states, quiet) -> dict:
                           f": {v['detail']}")
             for step in v.get("path", [])[-6:]:
                 _print(quiet, f"      > {step}")
+            for step in v.get("cycle", []):
+                _print(quiet, f"      @ {step}")
             for line in v.get("state_render", []):
                 _print(quiet, f"      | {line}")
     return out
@@ -117,6 +161,48 @@ def run_lint(paths, quiet) -> dict:
             "findings": [f.as_dict() for f in findings]}
 
 
+def run_jaxpr(quiet) -> dict:
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import lint_jaxpr
+    rep = lint_jaxpr.lint()
+    guard = lint_jaxpr.recompile_guard()
+    rep["recompile_guard"] = guard
+    rep["ok"] = bool(rep["ok"] and guard["ok"])
+    counts = ", ".join(f"{k}={v}" for k, v in rep["targets"].items())
+    _print(quiet, f"== jaxpr lint: {'ok' if rep['ok'] else 'FAIL'} "
+                  f"[{counts}; budget {rep['budget']}]")
+    for f in rep["findings"]:
+        _print(quiet, f"  ! {f['target']}: {f['rule']} — {f['detail']}")
+    _print(quiet, f"   recompile guard: async cache={guard['async_cache_size']} "
+                  f"sync cache={guard['sync_cache_size']} "
+                  f"native reuse={guard['native_build_reused']}")
+    return rep
+
+
+def run_fuzz(n_cases, seed, mutation, repro_dir, quiet) -> dict:
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import fuzz as fz
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import shrink as sh
+    mp = _resolve_mutation(mutation)[0]
+    rep = fz.fuzz(n_cases, seed=seed, message_phase=mp)
+    _print(quiet,
+           f"== fuzz: {'ok' if rep['ok'] else 'FAIL'} "
+           f"[{n_cases} cases, seed {seed}, "
+           f"{rep['coverage_points']} coverage points, "
+           f"verdicts {rep['verdicts']}, "
+           f"{rep['quirk_cases']} quirk-only cases]")
+    if rep["findings"]:
+        shrunk = sh.shrink_findings(rep, out_root=repro_dir,
+                                    message_phase=mp, limit=2)
+        rep["shrunk"] = shrunk
+        for s in shrunk:
+            _print(quiet,
+                   f"  ! case {s['case_id']}: {s['verdict']} — "
+                   f"{s['detail']}; shrunk {s['instrs_before']} -> "
+                   f"{s['instrs_after']} instrs ({s['runs']} runs)")
+        if repro_dir:
+            _print(quiet, f"   repros written under {repro_dir}")
+    return rep
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_scopes:
@@ -127,24 +213,42 @@ def main(argv=None) -> int:
                   f"{d['programs']}")
         return 0
 
-    report = {"model_check": {}, "lint": None}
-    ok = True
+    report = {"model_check": {}, "lint": None, "jaxpr": None,
+              "fuzz": None}
+    ok, exhausted = True, False
     if not args.skip_model_check:
         report["model_check"] = run_model_check(
             args.scopes, args.mutation, args.max_states, args.quiet)
-        ok &= all(r["ok"] for r in report["model_check"].values())
+        for r in report["model_check"].values():
+            if r.get("budget_exhausted"):
+                exhausted = True
+            else:
+                ok &= r["ok"]
     if not args.skip_lint:
         report["lint"] = run_lint(args.lint_paths, args.quiet)
         ok &= report["lint"]["ok"]
-    report["ok"] = ok
+    if args.jaxpr:
+        report["jaxpr"] = run_jaxpr(args.quiet)
+        ok &= report["jaxpr"]["ok"]
+    if args.fuzz > 0:
+        report["fuzz"] = run_fuzz(args.fuzz, args.seed, args.mutation,
+                                  args.repro_dir, args.quiet)
+        ok &= report["fuzz"]["ok"]
+    report["ok"] = bool(ok and not exhausted)
 
     if args.json_path:
         with open(args.json_path, "w") as fh:
             json.dump(report, fh, indent=2, sort_keys=True)
         _print(args.quiet, f"report written to {args.json_path}")
 
-    print("analyze:", "PASS" if ok else "FAIL")
-    return 0 if ok else 1
+    if not ok:
+        print("analyze: FAIL")
+        return 1
+    if exhausted:
+        print("analyze: BUDGET EXHAUSTED (no finding — not a pass)")
+        return 3
+    print("analyze: PASS")
+    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,182 @@
+"""Trace shrinking: ddmin a diverging fuzz case to a minimal repro.
+
+Zeller's delta debugging over the flattened (node, instruction) list of
+a :class:`..analysis.fuzz.FuzzCase` — per-node program order is
+preserved, everything else (dimensions, schedule knobs, arbitration) is
+held fixed so the predicate stays deterministic. The predicate is "the
+same verdict kind reproduces" under :func:`fuzz.run_case`, so a shrink
+of a ``state`` divergence cannot silently drift into a different bug.
+
+The minimized case is emitted as a ready-to-run fixture directory —
+``core_<n>.txt`` files in the exact reference trace format
+(``RD 0x<addr>`` / ``WR 0x<addr> <value>``, parseable by
+utils.trace.load_test_dir and the reference's own ``fscanf`` loop) plus
+``repro.json`` (the full case + verdict) and ``trace.perfetto.json``, a
+Perfetto event trace of the diverging run captured through
+ops.step.run_cycles_traced and obs/perfetto.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ue22cs343bb1_openmp_assignment_tpu.analysis import fuzz
+from ue22cs343bb1_openmp_assignment_tpu.obs import perfetto
+from ue22cs343bb1_openmp_assignment_tpu.ops import step
+from ue22cs343bb1_openmp_assignment_tpu.state import init_state
+from ue22cs343bb1_openmp_assignment_tpu.utils import eventlog
+
+#: cycles captured into the emitted Perfetto trace (enough for any
+#: shrunk repro at reference dimensions to reach quiescence or expose
+#: its hang)
+TRACE_CYCLES = 256
+
+
+def _flatten(case: fuzz.FuzzCase) -> List[Tuple[int, tuple]]:
+    return [(n, ins) for n, tr in enumerate(case.traces) for ins in tr]
+
+
+def _rebuild(case: fuzz.FuzzCase,
+             items: List[Tuple[int, tuple]]) -> fuzz.FuzzCase:
+    per_node: list = [[] for _ in range(case.num_nodes)]
+    for n, ins in items:
+        per_node[n].append(ins)
+    return dataclasses.replace(
+        case, traces=tuple(tuple(tr) for tr in per_node))
+
+
+def ddmin(items: list, test: Callable[[list], bool]) -> list:
+    """Classic ddmin: assumes test(items) is True; returns a 1-minimal
+    sublist (order-preserving) still satisfying test."""
+    n = 2
+    while len(items) >= 2:
+        size = len(items) // n
+        chunks = [items[i:i + size] for i in range(0, len(items), size)]
+        reduced = False
+        for c in chunks:                      # try each subset
+            if len(c) < len(items) and test(c):
+                items, n, reduced = c, 2, True
+                break
+        if not reduced:
+            for i in range(len(chunks)):      # try each complement
+                comp = [x for j, c in enumerate(chunks) if j != i
+                        for x in c]
+                if len(comp) < len(items) and test(comp):
+                    items, n = comp, max(2, n - 1)
+                    reduced = True
+                    break
+        if not reduced:
+            if n >= len(items):
+                break
+            n = min(len(items), 2 * n)
+    return items
+
+
+def shrink_case(case: fuzz.FuzzCase,
+                message_phase: Optional[Callable] = None,
+                verdict: Optional[str] = None) -> dict:
+    """Minimize ``case`` to the fewest instructions that still produce
+    the same verdict kind. Returns {case, verdict, detail, runs,
+    instrs_before, instrs_after}; predicate results are memoized so the
+    engine runs once per distinct candidate."""
+    if verdict is None:
+        verdict = fuzz.run_case(case, message_phase)["verdict"]
+    if verdict == "ok":
+        raise ValueError("refusing to shrink a passing case")
+    cache: dict = {}
+    runs = [0]
+
+    def test(items: list) -> bool:
+        key = tuple(items)
+        if key not in cache:
+            runs[0] += 1
+            res = fuzz.run_case(_rebuild(case, list(items)),
+                                message_phase)
+            cache[key] = res["verdict"] == verdict
+        return cache[key]
+
+    items = _flatten(case)
+    kept = ddmin(items, test)
+    small = _rebuild(case, kept)
+    res = fuzz.run_case(small, message_phase)
+    assert res["verdict"] == verdict, "shrink lost the bug"
+    return {"case": small, "verdict": verdict, "detail": res["detail"],
+            "runs": runs[0], "instrs_before": len(items),
+            "instrs_after": len(kept)}
+
+
+# -- repro emission --------------------------------------------------------
+
+
+def _trace_lines(tr) -> str:
+    out = []
+    for op, a, v in tr:
+        out.append(f"RD 0x{a:02X}" if op == 0 else f"WR 0x{a:02X} {v}")
+    # no trailing blank line for an idle node: parse_trace loads any
+    # non-RD/WR line (even empty) as an explicit NOP instruction
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def emit_repro(shrunk: dict, out_dir: str,
+               message_phase: Optional[Callable] = None) -> dict:
+    """Write the shrunk case as a fixture directory: per-node
+    ``core_<n>.txt`` (reference trace format), ``repro.json``, and a
+    validated ``trace.perfetto.json`` of the diverging run. Returns the
+    repro metadata dict."""
+    case = shrunk["case"]
+    cfg = case.config()
+    os.makedirs(out_dir, exist_ok=True)
+    for n, tr in enumerate(case.traces):
+        with open(os.path.join(out_dir, f"core_{n}.txt"), "w") as f:
+            f.write(_trace_lines(tr))
+
+    st = init_state(cfg, case.trace_lists(),
+                    issue_delay=np.array(case.delays, np.int32),
+                    issue_period=np.array(case.periods, np.int32),
+                    arb_rank=np.array(case.rank, np.int32))
+    _, events = step.run_cycles_traced(cfg, st, TRACE_CYCLES,
+                                       message_phase)
+    doc = perfetto.build_trace(eventlog.to_records(events),
+                               cfg.num_nodes)
+    perfetto.validate_trace(doc)
+    perfetto.write_trace(os.path.join(out_dir, "trace.perfetto.json"),
+                         doc)
+
+    meta = {"schema": "cache-sim/repro/v1",
+            "verdict": shrunk["verdict"], "detail": shrunk["detail"],
+            "instrs": shrunk["instrs_after"],
+            "num_nodes": case.num_nodes,
+            "case": case.to_dict(),
+            "files": sorted(os.listdir(out_dir)) + ["repro.json"]}
+    with open(os.path.join(out_dir, "repro.json"), "w") as f:
+        json.dump(meta, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return meta
+
+
+def shrink_findings(report: dict, out_root: Optional[str] = None,
+                    message_phase: Optional[Callable] = None,
+                    limit: int = 3) -> list:
+    """Shrink up to ``limit`` findings of a fuzz report; returns the
+    shrunk summaries (and writes repro dirs under ``out_root`` when
+    given)."""
+    out = []
+    for k, finding in enumerate(report.get("findings", [])[:limit]):
+        case = fuzz.case_from_dict(finding["case"])
+        shrunk = shrink_case(case, message_phase,
+                             verdict=finding["verdict"])
+        if out_root is not None:
+            emit_repro(shrunk, os.path.join(
+                out_root, f"repro_{case.case_id}"), message_phase)
+        out.append({"case_id": case.case_id,
+                    "verdict": shrunk["verdict"],
+                    "detail": shrunk["detail"],
+                    "instrs_before": shrunk["instrs_before"],
+                    "instrs_after": shrunk["instrs_after"],
+                    "runs": shrunk["runs"]})
+    return out

@@ -26,7 +26,9 @@ def build_parser() -> argparse.ArgumentParser:
         prog="cache-sim",
         description="TPU-native directory/MESI coherence simulator "
                     "(`cache-sim analyze` runs the static-analysis gate: "
-                    "protocol model checker + JAX trace lint)")
+                    "symmetry-reduced protocol model checker, AST + "
+                    "jaxpr lint, and the --fuzz differential fuzzer "
+                    "with ddmin trace shrinking)")
     p.add_argument("test_dir", nargs="?", default=None,
                    help="test directory name (reference-compat positional)")
     p.add_argument("--tests-root", default="tests",

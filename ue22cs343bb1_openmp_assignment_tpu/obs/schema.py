@@ -128,6 +128,26 @@ def from_native(m: dict, engine: str = "native") -> dict:
 
 
 # lint: host
+def coverage_signature(doc: dict, dir_occupancy: Optional[dict] = None):
+    """Project a v1 report (plus optional directory-state occupancy
+    counts) onto a small hashable coverage point for analysis/fuzz.py.
+
+    The signature deliberately quantizes: which message types appeared
+    at all, which latency buckets are occupied, which core counters are
+    nonzero, and the exact directory-state occupancy of the final
+    state. Two runs with the same signature exercised the same protocol
+    surface; the fuzzer keeps one corpus entry per signature. Not part
+    of the report schema — :func:`validate` does not know about it."""
+    bt = (doc.get("messages") or {}).get("by_type") or {}
+    lat = doc.get("latency_cycles") or {"counts": ()}
+    return (doc.get("engine"),
+            tuple(int(bool(doc.get(k))) for k in CORE_COUNTERS),
+            tuple(sorted(k for k, v in bt.items() if v)),
+            tuple(i for i, c in enumerate(lat["counts"]) if c),
+            tuple(sorted((dir_occupancy or {}).items())))
+
+
+# lint: host
 def validate(doc: dict) -> dict:
     """Check a report against the v1 schema; returns the doc, raises
     ValueError listing every violation. Dependency-free on purpose —

@@ -321,21 +321,26 @@ def _ro_outside(state: SimState):
     return state.replace(**placeholders), ro, placeholders
 
 
-@functools.partial(jax.jit, static_argnums=(0, 2))
+@functools.partial(jax.jit, static_argnums=(0, 2, 3))
 def run_cycles_traced(cfg: SystemConfig, state: SimState,
-                      num_cycles: int):
+                      num_cycles: int, message_phase=None):
     """Scan `num_cycles` cycles collecting the per-cycle event record.
 
     Returns (state, events) with events a dict of [num_cycles, N]
     arrays — the structured replacement for the reference's printf
     tracing (utils.eventlog formats them into the exact
     ``instruction_order.txt`` line format).
+
+    ``message_phase`` is the same static handler-phase override `cycle`
+    takes — it lets the differential fuzzer's shrinker (analysis/
+    shrink.py) capture an event trace of a *mutated* engine run.
     """
 
     carry0, ro, blanks = _ro_outside(state)
 
     def body(s, _):
-        out, ev = cycle(cfg, s.replace(**ro), with_events=True)
+        out, ev = cycle(cfg, s.replace(**ro), with_events=True,
+                        message_phase=message_phase)
         return out.replace(**blanks), ev
 
     final, events = jax.lax.scan(body, carry0, None, length=num_cycles)
@@ -379,7 +384,7 @@ def run_cycles(cfg: SystemConfig, state: SimState,
 
 
 def _run_quiescence(cfg: SystemConfig, state: SimState, chunk: int,
-                    max_cycles: int) -> SimState:
+                    max_cycles: int, message_phase=None) -> SimState:
     """while(not quiescent and cycle < max_cycles): scan `chunk` cycles.
 
     The termination predicate runs once per chunk, so a run may exceed
@@ -392,7 +397,7 @@ def _run_quiescence(cfg: SystemConfig, state: SimState, chunk: int,
     carry0, ro, blanks = _ro_outside(state)
 
     def body(s, _):
-        out = cycle(cfg, s.replace(**ro))
+        out = cycle(cfg, s.replace(**ro), message_phase=message_phase)
         return out.replace(**blanks), None
 
     def cond(s):
@@ -406,15 +411,19 @@ def _run_quiescence(cfg: SystemConfig, state: SimState, chunk: int,
     return final.replace(**ro)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 2))
+@functools.partial(jax.jit, static_argnums=(0, 2, 3))
 def run_to_quiescence(cfg: SystemConfig, state: SimState,
-                      max_cycles: int = 100_000) -> SimState:
+                      max_cycles: int = 100_000,
+                      message_phase=None) -> SimState:
     """Run until no work remains, stopping exactly at max_cycles.
 
     Replaces the reference's sleep-1s-then-kill harness
-    (``test3.sh:9-12``) with an exact fixpoint.
+    (``test3.sh:9-12``) with an exact fixpoint. ``message_phase`` is
+    `cycle`'s static handler-phase override — the differential fuzzer
+    (analysis/fuzz.py) uses it to run a seeded-mutant engine to
+    quiescence against the clean native oracle.
     """
-    return _run_quiescence(cfg, state, 1, max_cycles)
+    return _run_quiescence(cfg, state, 1, max_cycles, message_phase)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 2, 3))
