@@ -22,6 +22,7 @@ Prints exactly one JSON line:
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -124,6 +125,25 @@ def main():
                          "SURVEY §5 tracing)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny config on CPU for smoke testing")
+    ap.add_argument("--record", metavar="PATH",
+                    help="append this run to a bench-history JSONL "
+                         "(cache-sim/bench/v1: full rep vector, config "
+                         "fingerprint, git sha); compare entries with "
+                         "`cache-sim bench-diff --history PATH "
+                         "--against-last`")
+    ap.add_argument("--max-cycles", type=int, default=None,
+                    help="override the cycle/round budget (default "
+                         "200*trace_len); a run that fails to go "
+                         "quiescent inside it exits 1")
+    ap.add_argument("--timer-check", action="store_true",
+                    help="run the obs.profiler timer self-check: is "
+                         "block_until_ready a real barrier on this "
+                         "link, or must timings sync via device_get "
+                         "(PERF.md)? Result rides in the stderr extra")
+    ap.add_argument("--kernel-costs", action="store_true",
+                    help="attach XLA's compiled cost analysis of the "
+                         "headline runner (flops/bytes, memory sizes) "
+                         "to the phase report (obs.profiler)")
     args = ap.parse_args()
     if args.reps < 1:
         ap.error("--reps must be >= 1")
@@ -221,7 +241,8 @@ def main():
     # while_loop): on a high-latency device link every eager op is a
     # network round trip, so host-side polling would dominate the
     # measurement.
-    max_cycles = 200 * args.trace_len
+    max_cycles = (args.max_cycles if args.max_cycles is not None
+                  else 200 * args.trace_len)
     if sync_like:
         # stay inside the claim-key round budget at very large N
         max_cycles = min(max_cycles, se.claim_max_rounds(cfg) - 1)
@@ -310,14 +331,25 @@ def main():
         total_retired(run())          # warmup; device_get = real sync
 
     if args.profile:
-        try:
-            with jax.profiler.trace(args.profile):
-                total_retired(run())
-            print(f"profiler trace written to {args.profile}",
-                  file=sys.stderr)
-        except Exception as e:  # some device plugins can't profile
-            print(f"warning: profiler capture failed: {e}",
-                  file=sys.stderr)
+        from ue22cs343bb1_openmp_assignment_tpu.obs import profiler
+        with profiler.capture(args.profile):
+            total_retired(run())
+
+    if args.kernel_costs:
+        # lower the actual jitted quiescence runner at the bench
+        # arguments; unavailable (never fatal) if the backend has no
+        # cost model or the path has no directly-jitted runner
+        from ue22cs343bb1_openmp_assignment_tpu.obs import profiler
+        if args.engine == "sync" and args.replicas > 1:
+            jitted, jargs = se._run_ensemble_jit, (cfg, st0, args.chunk,
+                                                   max_cycles)
+        elif sync_like:
+            jitted, jargs = se._run_sync_jit, (cfg, st0, args.chunk,
+                                               max_cycles)
+        else:
+            jitted, jargs = run_chunked_to_quiescence, (
+                cfg, st0, args.chunk, max_cycles)
+        profiler.attach_kernel_costs(timer, jitted, *jargs)
 
     # median of --reps timed runs: the device link is shared, with
     # ~1.5x run-to-run noise; the median is the defensible headline
@@ -365,8 +397,41 @@ def main():
         # surface the reference's silent-drop failure mode (quirk 6): a
         # throughput number with drops > 0 is not a clean run
         extra["msgs_dropped"] = int(state.metrics.msgs_dropped)
+    if args.timer_check:
+        from ue22cs343bb1_openmp_assignment_tpu.obs import profiler
+        extra["timer_check"] = profiler.timer_self_check(run, reps=1)
     print(json.dumps(result))
     print(json.dumps(extra), file=sys.stderr)
+
+    if args.record:
+        from ue22cs343bb1_openmp_assignment_tpu.obs import history
+        fingerprint = {
+            "engine": args.engine, "workload": args.workload,
+            "nodes": args.nodes, "trace_len": args.trace_len,
+            "chunk": args.chunk, "reps": args.reps,
+            "max_cycles": max_cycles, "replicas": args.replicas,
+            "procedural": bool(args.procedural and sync_like),
+            "sharded": bool(args.sharded), "devices": n_dev,
+            "platform": jax.devices()[0].platform,
+            "smoke": bool(args.smoke),
+        }
+        doc = history.entry(
+            label=f"{args.engine}@{args.nodes}", source="bench.py",
+            result=result, extra=extra, config=fingerprint,
+            sha=history.git_sha(os.path.dirname(
+                os.path.abspath(__file__))),
+            captured_at=time.strftime("%Y-%m-%dT%H:%M:%S%z"))
+        history.append(args.record, doc)
+        print(f"recorded to {args.record}", file=sys.stderr)
+
+    if not quiet:
+        # a non-quiescent run measured dispatch of an unfinished
+        # workload — the number is not a headline and CI gates
+        # (scripts/check.sh bench-smoke) must be able to trust rc
+        print(f"error: not quiescent within {max_cycles} "
+              f"cycles/rounds — result is not a valid headline",
+              file=sys.stderr)
+        return 1
 
 
 if __name__ == "__main__":

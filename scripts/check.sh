@@ -20,6 +20,12 @@
 # The obs smoke step runs `cache-sim stats` on the mini fixture and
 # validates the emitted report against the cache-sim/metrics/v1 schema
 # (the golden comparison lives in tests/test_obs.py).
+#
+# The bench-smoke gate exercises the noise-aware regression harness
+# end to end: the archived r03/r04 captures must classify as noise
+# (exit 0) and a synthetic +12% slowdown as a regression (exit 4) —
+# the detector's own mutation test — then a tiny CPU bench run is
+# recorded into a throwaway history and diffed --against-last.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -41,8 +47,28 @@ print("obs smoke: ok (schema", doc["schema"] + ",",
       doc["instrs_retired"], "instrs)")
 PY
 
+python -m ue22cs343bb1_openmp_assignment_tpu.cli bench-diff \
+    BENCH_r03.json BENCH_r04.json
+rc=0
+python -m ue22cs343bb1_openmp_assignment_tpu.cli bench-diff \
+    BENCH_r03.json --synthetic-slowdown 12 || rc=$?
+if [[ "$rc" != 4 ]]; then
+    echo "bench-diff self-test FAILED: synthetic +12% slowdown" \
+         "exited $rc, want 4" >&2
+    exit 1
+fi
+BENCH_HIST="${BENCH_HIST:-/tmp/_bench_hist.jsonl}"
+rm -f "$BENCH_HIST"
+timeout -k 5 300 python bench.py --smoke --engine async --reps 2 \
+    --record "$BENCH_HIST" > /dev/null
+timeout -k 5 300 python bench.py --smoke --engine async --reps 2 \
+    --record "$BENCH_HIST" > /dev/null
+python -m ue22cs343bb1_openmp_assignment_tpu.cli bench-diff \
+    --history "$BENCH_HIST" --against-last
+
 if [[ "${1:-}" == "--analyze" ]]; then
     exit 0
 fi
 
-python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
+python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider \
+    --durations=15

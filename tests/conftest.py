@@ -39,3 +39,38 @@ def reference_available() -> bool:
 requires_reference = pytest.mark.skipif(
     not reference_available(),
     reason="reference fixture tree not mounted at /root/reference/tests")
+
+
+# Parametrized cases that individually cost >20 s on the single-core CI
+# box (measured via --durations=0; see PERF.md). Whole tests that are
+# uniformly slow carry @pytest.mark.slow at their definition; the entries
+# here are the heavy OUTLIER params of otherwise-fast parametrized tests,
+# so the fast params keep covering the differential gates in tier-1
+# while the 870 s budget holds. The full suite (no -m filter) still runs
+# everything.
+_SLOW_PARAM_IDS = {
+    "tests/test_native_enumeration.py::"
+    "test_deep_outcomes_within_native_enumeration[storm_home_chain-1-False]",
+    "tests/test_native_enumeration.py::"
+    "test_deep_outcomes_within_native_enumeration[wave_home_chain-1-False]",
+    "tests/test_outcome_inclusion.py::"
+    "test_multi_txn_window_outcomes_are_reachable[migrate3]",
+    "tests/test_outcome_inclusion.py::"
+    "test_deep_wave_outcomes_are_reachable[wave_home_chain-1]",
+    "tests/test_outcome_inclusion.py::"
+    "test_deep_wave_outcomes_are_reachable[wave_home_chain-3]",
+    "tests/test_outcome_inclusion.py::"
+    "test_deep_read_storm_outcomes_are_reachable[storm_home_chain-1]",
+    "tests/test_outcome_inclusion.py::"
+    "test_deep_read_storm_outcomes_are_reachable[storm_home_chain-2]",
+    "tests/test_bench_contract.py::"
+    "test_single_json_line_on_stdout[args0]",
+    "tests/test_bench_contract.py::"
+    "test_single_json_line_on_stdout[args3]",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.nodeid in _SLOW_PARAM_IDS:
+            item.add_marker(pytest.mark.slow)

@@ -72,6 +72,7 @@ def test_native_workload_long_trace(tmp_path, monkeypatch, capsys):
     assert metrics["instrs_retired"] == 8 * 64
 
 
+@requires_reference
 def test_native_nodes_beyond_fixture_errors(tmp_path, monkeypatch,
                                             capsys):
     """--nodes larger than the fixture's core files fails loudly (like

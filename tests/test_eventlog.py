@@ -165,6 +165,7 @@ def test_to_lines_byte_parity_with_fixture():
     assert ours == fixture
 
 
+@requires_reference
 def test_multi_txn_window_trace_log_program_order():
     """Multi-transaction windows (txn_width>1) must still emit a
     retirement log whose per-node projection is exact program order."""

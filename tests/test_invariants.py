@@ -112,6 +112,7 @@ def test_detects_unowned_with_sharers():
     assert int(v["unowned_with_sharers"]) == 1
 
 
+@requires_reference
 def test_detects_hidden_copy_at_quiescence():
     """A valid cache line the home directory doesn't know about — the
     coherence bug class the protocol exists to prevent."""

@@ -138,6 +138,7 @@ def _random_trace(rng):
     return traces
 
 
+@pytest.mark.slow  # ~35 s/case single-CPU: dense native enumeration
 @pytest.mark.parametrize("case_seed", range(6))
 def test_fuzzed_microtraces_within_native_enumeration(case_seed):
     """Seeded random micro-traces: the deep engine's outcome (classic +

@@ -40,6 +40,7 @@ def _assert_states_equal(a, b):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
+@pytest.mark.slow  # >30 s single-CPU (deep+pallas double compile)
 def test_rounds_bit_identical_mid_run():
     """Jitted multi-round equality on a warmed machine, where chains,
     absorbed requests and truncations occur."""
@@ -52,6 +53,7 @@ def test_rounds_bit_identical_mid_run():
     se.check_exact_directory(pcfg, b)
 
 
+@pytest.mark.slow  # >60 s single-CPU (deep+pallas double compile)
 def test_rounds_bit_identical_contended():
     """Same, at 20% locality (request-absorption heavy)."""
     cfg, pcfg = _cfgs(local_permille=200)
@@ -63,6 +65,7 @@ def test_rounds_bit_identical_contended():
     se.check_exact_directory(pcfg, b)
 
 
+@pytest.mark.slow  # >40 s single-CPU (deep+pallas double compile)
 def test_rounds_bit_identical_waves():
     """Absorption waves (deep_waves > 1, mixed classes) run under
     either fold backend — the round middle is shared code

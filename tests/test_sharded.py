@@ -66,6 +66,7 @@ def test_cross_shard_messages():
     assert int(out.dir_bitvec[31, 3, 0]) == 1
 
 
+@pytest.mark.slow  # ~120 s single-CPU: compiles the full 8-chip mesh
 def test_dryrun_multichip_entrypoint():
     import __graft_entry__
     __graft_entry__.dryrun_multichip(8)

@@ -33,6 +33,7 @@ reach.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Callable, Optional
 
 import numpy as np
@@ -283,13 +284,18 @@ def _sync_join(cfg, traces, fin) -> tuple:
 
 def fuzz(n_cases: int = 32, seed: int = 0,
          message_phase: Optional[Callable] = None,
-         progress: Optional[Callable] = None) -> dict:
+         progress: Optional[Callable] = None,
+         flight_dir: Optional[str] = None) -> dict:
     """Run the coverage-guided loop; returns the fuzz report.
 
     Every fourth fresh case is node-local so the sync and coherence
     oracles stay exercised; once the corpus is non-empty, half the
     cases are mutations of a coverage-novel ancestor. Deterministic:
     (n_cases, seed, message_phase) fixes the report bit-for-bit.
+
+    ``flight_dir`` arms the flight recorder (obs/flight.py): every
+    finding re-runs under telemetry capture and dumps a replayable
+    ``incident_<case_id>`` directory underneath it.
     """
     rng = np.random.default_rng(seed)
     corpus: list = []
@@ -311,6 +317,18 @@ def fuzz(n_cases: int = 32, seed: int = 0,
             findings.append({"verdict": v, "detail": res["detail"],
                              "cycles": res["cycles"],
                              "case": case.to_dict()})
+            if flight_dir is not None:
+                # lazy: obs.flight imports back into analysis for the
+                # repro emission, so neither package imports the other
+                # at module load
+                from ue22cs343bb1_openmp_assignment_tpu.obs import (
+                    flight as _flight)
+                fr = _flight.record_case(case, message_phase)
+                fr.run(max(res["cycles"], 1), stop_on_quiescence=False)
+                fr.dump_incident(
+                    os.path.join(flight_dir,
+                                 f"incident_{case.case_id}"),
+                    f"fuzz:{v}", res["detail"], case=case.to_dict())
         if res["coverage"] not in seen:
             seen.add(res["coverage"])
             corpus.append(case)

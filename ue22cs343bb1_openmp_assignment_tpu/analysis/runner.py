@@ -62,6 +62,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--repro-dir", default=None, metavar="DIR",
                    help="write shrunk fuzz repros here (core_<n>.txt "
                         "fixture + repro.json + Perfetto trace)")
+    p.add_argument("--flight-dir", default=None, metavar="DIR",
+                   help="arm the flight recorder: every fuzz finding "
+                        "dumps a replayable incident_<case_id> dir "
+                        "here (telemetry ring + metrics + Perfetto "
+                        "trace + repro fixture; obs/flight.py)")
     p.add_argument("--jaxpr", action="store_true",
                    help="run the jaxpr IR lint over the ops/ hot paths "
                         "plus the three-engine recompilation guard")
@@ -178,11 +183,13 @@ def run_jaxpr(quiet) -> dict:
     return rep
 
 
-def run_fuzz(n_cases, seed, mutation, repro_dir, quiet) -> dict:
+def run_fuzz(n_cases, seed, mutation, repro_dir, quiet,
+             flight_dir=None) -> dict:
     from ue22cs343bb1_openmp_assignment_tpu.analysis import fuzz as fz
     from ue22cs343bb1_openmp_assignment_tpu.analysis import shrink as sh
     mp = _resolve_mutation(mutation)[0]
-    rep = fz.fuzz(n_cases, seed=seed, message_phase=mp)
+    rep = fz.fuzz(n_cases, seed=seed, message_phase=mp,
+                  flight_dir=flight_dir)
     _print(quiet,
            f"== fuzz: {'ok' if rep['ok'] else 'FAIL'} "
            f"[{n_cases} cases, seed {seed}, "
@@ -200,6 +207,9 @@ def run_fuzz(n_cases, seed, mutation, repro_dir, quiet) -> dict:
                    f"{s['instrs_after']} instrs ({s['runs']} runs)")
         if repro_dir:
             _print(quiet, f"   repros written under {repro_dir}")
+        if flight_dir:
+            _print(quiet, f"   flight-recorder incidents under "
+                          f"{flight_dir}")
     return rep
 
 
@@ -232,7 +242,8 @@ def main(argv=None) -> int:
         ok &= report["jaxpr"]["ok"]
     if args.fuzz > 0:
         report["fuzz"] = run_fuzz(args.fuzz, args.seed, args.mutation,
-                                  args.repro_dir, args.quiet)
+                                  args.repro_dir, args.quiet,
+                                  flight_dir=args.flight_dir)
         ok &= report["fuzz"]["ok"]
     report["ok"] = bool(ok and not exhausted)
 

@@ -107,6 +107,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="include message-dequeue events in --trace-log "
                         "(the reference's -DDEBUG_MSG, "
                         "assignment.c:179-182)")
+    p.add_argument("--flight-dir", metavar="DIR",
+                   help="arm the failure flight recorder (obs/flight): "
+                        "on a hang, watchdog trip, or --check "
+                        "invariant failure, dump a self-contained "
+                        "incident dir here (last --flight-ring cycles "
+                        "of telemetry + metrics doc + Perfetto trace "
+                        "of the deterministic replay)")
+    p.add_argument("--flight-ring", type=int, default=64,
+                   help="flight recorder ring depth in cycles "
+                        "(default 64)")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend (default: first device)")
     p.add_argument("--engine", choices=["async", "sync", "native", "omp"],
@@ -196,6 +206,7 @@ def _main_sync(args) -> int:
                       ("periods", "message-level issue schedules"),
                       ("drop_prob", "message-drop fault injection"),
                       ("trace_msgs", "message-dequeue event tracing"),
+                      ("flight_dir", "the telemetry flight recorder"),
                       ("admission", "mailbox backpressure")):
         if getattr(args, flag):
             print(f"error: --{flag.replace('_', '-')} needs the mailbox "
@@ -408,6 +419,7 @@ def _main_native(args) -> int:
 
     for flag, why in (("drop_prob", "fault injection"),
                       ("trace_log", "event tracing"),
+                      ("flight_dir", "the telemetry flight recorder"),
                       ("admission", "admission gating"),
                       ("save_checkpoint", "checkpointing"),
                       ("resume", "checkpointing"),
@@ -594,6 +606,9 @@ def main(argv=None) -> int:
     if raw[:1] == ["trace"]:
         from ue22cs343bb1_openmp_assignment_tpu.obs import cli as obs_cli
         return obs_cli.main_trace(raw[1:])
+    if raw[:1] == ["bench-diff"]:
+        from ue22cs343bb1_openmp_assignment_tpu.obs import cli as obs_cli
+        return obs_cli.main_bench_diff(raw[1:])
     args = build_parser().parse_args(raw)
     if args.cpu:
         import jax
@@ -707,6 +722,25 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
+    # flight recorder: snapshot the pre-run state; on an incident the
+    # deterministic engine replays it under telemetry capture, so the
+    # normal path pays nothing
+    flight0 = system.state if args.flight_dir else None
+
+    def _flight_dump(reason: str, detail: str = "") -> None:
+        if flight0 is None:
+            return
+        from ue22cs343bb1_openmp_assignment_tpu.obs import flight
+        fr = flight.FlightRecorder(system.cfg, flight0,
+                                   k=args.flight_ring)
+        fr.run(max(1, int(system.state.cycle) - int(flight0.cycle)),
+               stop_on_quiescence=False)
+        out = os.path.join(args.flight_dir,
+                           f"incident_{reason.split(':', 1)[0]}")
+        fr.dump_incident(out, reason, detail)
+        print(f"flight recorder: incident dumped to {out}",
+              file=sys.stderr)
+
     if args.trace_log:
         from ue22cs343bb1_openmp_assignment_tpu.utils import eventlog
         trace_base = int(system.state.cycle)
@@ -746,6 +780,14 @@ def main(argv=None) -> int:
                   f"(first few: {report['nodes'][:4]}); recover by "
                   "resuming a checkpoint with backpressure (--admission) "
                   "or a different schedule", file=sys.stderr)
+            _flight_dump("watchdog:stall",
+                         f"{report['count']} node(s) stalled "
+                         f">{args.stall_threshold} cycles; nodes "
+                         f"{report['nodes'][:4]}")
+        else:
+            _flight_dump("hang:not_quiescent",
+                         f"not quiescent after {args.max_cycles} "
+                         f"cycles{hint}")
 
     if args.check or args.check_strict:
         try:
@@ -753,12 +795,15 @@ def main(argv=None) -> int:
                 strict_coherence=args.check_strict)
         except AssertionError as e:
             print(f"invariant check FAILED: {e}", file=sys.stderr)
+            _flight_dump("invariant:check", str(e))
             return 3
         if not system.quiescent:
             # the coherence tier is only defined at quiescence
             if args.check_strict:
                 print("invariant check FAILED: machine not quiescent — "
                       "coherence tier not checkable", file=sys.stderr)
+                _flight_dump("invariant:not_quiescent",
+                             "coherence tier not checkable")
                 return 3
             print("invariant check passed (engine tier only; not "
                   "quiescent, coherence tier skipped)", file=sys.stderr)

@@ -12,8 +12,22 @@ One event/metric surface for all engines:
   device_get sync), wired into bench.py.
 - :mod:`obs.timeseries` — host rendering of the on-device telemetry
   samples (ops.step.run_cycles_telemetry).
-- :mod:`obs.cli` — the ``cache-sim stats`` / ``cache-sim trace``
-  subcommands.
+- :mod:`obs.history` — append-only ``cache-sim/bench/v1`` benchmark
+  history (full rep vectors + config fingerprint + git sha), fed by
+  ``bench.py --record`` and by ingesting archived ``BENCH_r*.json``.
+- :mod:`obs.regress` — noise-aware bench comparator (exact
+  Mann-Whitney U on rep times + a practical bar from recorded rep
+  spread), the brain of ``cache-sim bench-diff``.
+- :mod:`obs.profiler` — ``jax.profiler`` trace capture around engine
+  runs, per-kernel compiled cost attribution folded into PhaseTimer
+  reports, and the timer self-check re-asserting PERF.md's
+  ``block_until_ready``-can-lie lesson.
+- :mod:`obs.flight` — failure flight recorder: ring buffer of the
+  last K cycles of telemetry; dumps replayable incident dirs (metrics
+  doc + Perfetto trace + analysis/shrink repro) on invariant trips,
+  watchdog hangs, and fuzzer findings.
+- :mod:`obs.cli` — the ``cache-sim stats`` / ``cache-sim trace`` /
+  ``cache-sim bench-diff`` subcommands.
 
 Everything in this package is host-side: it renders device arrays after
 the run; nothing here is traced (the on-device capture lives in

@@ -296,6 +296,122 @@ def cmd_trace(args) -> int:
 
 
 # lint: host
+def build_bench_diff_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cache-sim bench-diff",
+        description="noise-aware comparison of two bench captures "
+                    "(obs.regress: Mann-Whitney U on rep times + a "
+                    "practical bar from recorded rep spread). "
+                    "Exit 0 = noise/improvement/baseline, "
+                    "4 = regression, 2 = usage/incomparable.")
+    p.add_argument("a", nargs="?", default=None,
+                   help="baseline capture: BENCH_r*.json driver "
+                        "capture, raw bench.py output, or a history "
+                        "JSONL (its last entry is used)")
+    p.add_argument("b", nargs="?", default=None,
+                   help="candidate capture (same formats)")
+    p.add_argument("--history", metavar="PATH",
+                   help="bench history JSONL (see bench.py --record)")
+    p.add_argument("--against-last", action="store_true",
+                   help="compare the history's newest entry against "
+                        "the one before it; with a single entry, "
+                        "report 'baseline recorded' and exit 0")
+    p.add_argument("--synthetic-slowdown", type=float, metavar="PCT",
+                   help="self-test: compare A against a copy of A "
+                        "with rep times scaled by (1 + PCT/100) — "
+                        "must come out a regression (exit 4)")
+    p.add_argument("--min-effect", type=float, default=5.0,
+                   metavar="PCT",
+                   help="never flag deltas below this percent "
+                        "(default 5.0)")
+    p.add_argument("--alpha", type=float, default=0.05,
+                   help="one-sided significance level (default 0.05; "
+                        "note 3v3 reps bottom out at exactly 0.05)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the full verdict doc as JSON on stdout")
+    return p
+
+
+# lint: host
+def _load_bench_entry(path: str):
+    """A capture path -> one history entry. History JSONL files
+    contribute their newest entry; anything else goes through
+    obs.history.ingest_capture."""
+    from ue22cs343bb1_openmp_assignment_tpu.obs import history
+    try:
+        hist = history.load(path)
+        if hist:
+            return hist[-1]
+    except (ValueError, json.JSONDecodeError):
+        pass
+    return history.ingest_capture(path)
+
+
+# lint: host
+def cmd_bench_diff(args) -> int:
+    import copy
+
+    from ue22cs343bb1_openmp_assignment_tpu.obs import history, regress
+
+    def fail(msg: str) -> int:
+        print(f"error: {msg}", file=sys.stderr)
+        return 2
+
+    try:
+        if args.against_last:
+            if not args.history:
+                return fail("--against-last requires --history PATH")
+            if not os.path.exists(args.history):
+                return fail(f"history not found: {args.history}")
+            hist = history.load(args.history)
+            if not hist:
+                return fail(f"history is empty: {args.history}")
+            if len(hist) == 1:
+                print(f"bench-diff: baseline recorded "
+                      f"({hist[0]['label']}, 1 entry in "
+                      f"{args.history}); nothing to compare yet")
+                return 0
+            entry_a, entry_b = hist[-2], hist[-1]
+        else:
+            if not args.a:
+                return fail("provide captures A and B, or "
+                            "--history ... --against-last")
+            entry_a = _load_bench_entry(args.a)
+            if args.synthetic_slowdown is not None:
+                scale = 1.0 + args.synthetic_slowdown / 100.0
+                entry_b = copy.deepcopy(entry_a)
+                entry_b["label"] = (f"{entry_a['label']}"
+                                    f"*{scale:g} (synthetic)")
+                entry_b["rep_times_s"] = [
+                    t * scale for t in entry_a["rep_times_s"]]
+            elif args.b:
+                entry_b = _load_bench_entry(args.b)
+            else:
+                return fail("provide capture B (or "
+                            "--synthetic-slowdown PCT)")
+    except (OSError, ValueError) as e:
+        return fail(str(e))
+
+    rep = regress.compare(entry_a, entry_b,
+                          min_effect=args.min_effect / 100.0,
+                          alpha=args.alpha)
+    if args.json:
+        print(json.dumps(rep, sort_keys=True))
+    else:
+        print(regress.format_report(rep))
+    if rep["verdict"] == "regression":
+        return 4
+    if rep["verdict"] == "incomparable":
+        return 2
+    return 0
+
+
+# lint: host
+def main_bench_diff(argv) -> int:
+    return cmd_bench_diff(build_bench_diff_parser().parse_args(argv))
+
+
+# lint: host
 def main_stats(argv) -> int:
     args = build_stats_parser().parse_args(argv)
     if args.cpu:

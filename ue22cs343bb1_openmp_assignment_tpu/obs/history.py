@@ -1,0 +1,221 @@
+"""Bench history: the append-only record behind ``cache-sim bench-diff``.
+
+Before this module the repo's performance memory lived in loose
+``BENCH_r*.json`` driver captures that nothing parsed, and PERF.md
+argued each round's delta by hand. This module gives every benchmark
+capture one schema'd home — a JSONL file of ``cache-sim/bench/v1``
+entries carrying the FULL rep-time vector (the noise information the
+headline median throws away), a config fingerprint (so apples are only
+compared to apples), and the git sha — and adapters from both capture
+sources:
+
+- ``bench.py --record PATH`` appends the run it just measured;
+- :func:`ingest_capture` lifts an archived driver capture
+  (``BENCH_r*.json``: ``{"n", "cmd", "rc", "tail", "parsed"}``) or a
+  raw two-line ``bench.py`` output file into the same schema.
+
+The statistical comparator over these entries lives in
+:mod:`obs.regress`; this module is storage + validation only.
+Host-side by construction; dependency-free like obs.schema.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+from typing import List, Optional
+
+SCHEMA_ID = "cache-sim/bench/v1"
+
+#: entry keys, all always present (None marks "not captured")
+_TOP_KEYS = ("schema", "label", "source", "captured_at", "git_sha",
+             "metric", "unit", "value", "vs_baseline", "config",
+             "rep_times_s", "elapsed_s", "steps", "retired",
+             "quiescent", "phases")
+
+
+# lint: host
+def git_sha(repo_dir: Optional[str] = None) -> Optional[str]:
+    """Current commit sha, or None outside a work tree / without git."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=repo_dir,
+            capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+# lint: host
+def entry(label: str, source: str, result: dict, extra: dict,
+          config: Optional[dict] = None, sha: Optional[str] = None,
+          captured_at: Optional[str] = None) -> dict:
+    """Build a v1 entry from bench.py's two JSON lines.
+
+    ``result`` is the stdout line ({metric, value, unit, vs_baseline});
+    ``extra`` is the stderr line (engine, rep_times_s, quiescent, ...).
+    ``config`` is the benchmark fingerprint — whatever knobs determined
+    the measured computation; the metric string itself is always part
+    of the comparability check, so a partial fingerprint degrades
+    gracefully for archived captures.
+    """
+    doc = {
+        "schema": SCHEMA_ID,
+        "label": str(label),
+        "source": str(source),
+        "captured_at": captured_at,
+        "git_sha": sha,
+        "metric": result["metric"],
+        "unit": result["unit"],
+        "value": float(result["value"]),
+        "vs_baseline": float(result.get("vs_baseline", 0.0)),
+        "config": dict(config) if config else {"engine": extra.get("engine")},
+        "rep_times_s": [float(t) for t in extra.get("rep_times_s", [])],
+        "elapsed_s": (float(extra["elapsed_s"])
+                      if extra.get("elapsed_s") is not None else None),
+        "steps": (int(extra["steps"])
+                  if extra.get("steps") is not None else None),
+        "retired": (int(extra["retired"])
+                    if extra.get("retired") is not None else None),
+        "quiescent": (bool(extra["quiescent"])
+                      if extra.get("quiescent") is not None else None),
+        "phases": extra.get("phases"),
+    }
+    return validate_entry(doc)
+
+
+# lint: host
+def validate_entry(doc: dict) -> dict:
+    """Check an entry against the v1 schema; returns the doc, raises
+    ValueError listing every violation (same contract as
+    obs.schema.validate)."""
+    errs = []
+    if not isinstance(doc, dict):
+        raise ValueError(f"entry must be a dict, got {type(doc).__name__}")
+    for k in _TOP_KEYS:
+        if k not in doc:
+            errs.append(f"missing key: {k}")
+    for k in doc:
+        if k not in _TOP_KEYS:
+            errs.append(f"unknown key: {k}")
+    if doc.get("schema") != SCHEMA_ID:
+        errs.append(f"schema must be {SCHEMA_ID!r}, got {doc.get('schema')!r}")
+    for k in ("label", "source", "metric", "unit"):
+        if not isinstance(doc.get(k), str) or not doc.get(k):
+            errs.append(f"{k} must be a non-empty string")
+    v = doc.get("value")
+    if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+        errs.append(f"value must be a non-negative number, got {v!r}")
+    reps = doc.get("rep_times_s")
+    if (not isinstance(reps, list)
+            or any(not isinstance(t, (int, float)) or t <= 0
+                   for t in reps)):
+        errs.append("rep_times_s must be a list of positive numbers")
+    if not isinstance(doc.get("config"), dict):
+        errs.append("config must be a dict")
+    q = doc.get("quiescent")
+    if q is not None and not isinstance(q, bool):
+        errs.append("quiescent must be None or bool")
+    for k in ("steps", "retired"):
+        x = doc.get(k)
+        if x is not None and (not isinstance(x, int) or x < 0):
+            errs.append(f"{k} must be None or a non-negative int")
+    if errs:
+        raise ValueError("invalid bench-history entry:\n  "
+                         + "\n  ".join(errs))
+    return doc
+
+
+# lint: host
+def append(path: str, doc: dict) -> None:
+    """Append one validated entry to a JSONL history file."""
+    validate_entry(doc)
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(doc, sort_keys=True) + "\n")
+
+
+# lint: host
+def load(path: str) -> List[dict]:
+    """Load and validate every entry of a JSONL history file (blank
+    lines skipped); errors name the offending line."""
+    out = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            if not line.strip():
+                continue
+            try:
+                out.append(validate_entry(json.loads(line)))
+            except ValueError as e:
+                raise ValueError(f"{path}:{i}: {e}") from None
+    return out
+
+
+# lint: host
+def _json_lines(text: str) -> List[dict]:
+    docs = []
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                docs.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return docs
+
+
+# lint: host
+def ingest_capture(path: str, label: Optional[str] = None) -> dict:
+    """Lift an archived capture into a v1 entry.
+
+    Accepts either a round-driver capture (``BENCH_r*.json``: one JSON
+    object whose ``tail`` holds bench.py's two output lines and whose
+    ``parsed`` duplicates the stderr extra) or a raw file of bench.py
+    output lines. The default label is the filename stem (``BENCH_r03``
+    -> ``r03``).
+    """
+    with open(path) as f:
+        text = f.read()
+    stem = os.path.splitext(os.path.basename(path))[0]
+    if label is None:
+        label = stem[6:] if stem.startswith("BENCH_") else stem
+    result, extra = None, None
+    try:
+        cap = json.loads(text)
+    except json.JSONDecodeError:
+        cap = None
+    docs = (_json_lines(cap.get("tail", ""))
+            if isinstance(cap, dict) and "tail" in cap
+            else _json_lines(text))
+    if isinstance(cap, dict):
+        extra = cap.get("parsed")
+    for d in docs:
+        if "metric" in d and "value" in d:
+            result = d
+        elif "rep_times_s" in d:
+            extra = d
+    if result is None or extra is None:
+        raise ValueError(
+            f"{path}: no bench result/extra JSON lines found "
+            "(expected a BENCH_r*.json driver capture or raw bench.py "
+            "output)")
+    cmd = cap.get("cmd") if isinstance(cap, dict) else None
+    cfg = {"engine": extra.get("engine")}
+    if cmd:
+        cfg["cmd"] = cmd
+    return entry(label, os.path.basename(path), result, extra,
+                 config=cfg)
+
+
+# lint: host
+def last_two(path: str) -> tuple:
+    """(previous, last) entries of a history file; ValueError when it
+    holds fewer than two."""
+    hist = load(path)
+    if len(hist) < 2:
+        raise ValueError(
+            f"{path}: need at least 2 entries to diff, have {len(hist)}")
+    return hist[-2], hist[-1]

@@ -31,6 +31,14 @@ class PhaseTimer:
         self._total: Dict[str, float] = {}
         self._count: Dict[str, int] = {}
         self._order: List[str] = []
+        self._attached: Dict[str, object] = {}
+
+    # lint: host
+    def attach(self, key: str, doc) -> None:
+        """Attach a non-timing section (JSON-serializable) that rides
+        in the report — obs.profiler uses this to fold per-kernel
+        compiled cost attribution next to the wall-clock phases."""
+        self._attached[str(key)] = doc
 
     # lint: host
     def add(self, name: str, seconds: float) -> None:
@@ -59,5 +67,7 @@ class PhaseTimer:
         "total_seconds" rollup."""
         phases = {n: {"seconds": round(self._total[n], 6),
                       "count": self._count[n]} for n in self._order}
-        return {"phases": phases,
-                "total_seconds": round(sum(self._total.values()), 6)}
+        doc = {"phases": phases,
+               "total_seconds": round(sum(self._total.values()), 6)}
+        doc.update(self._attached)
+        return doc

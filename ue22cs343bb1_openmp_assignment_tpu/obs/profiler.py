@@ -1,0 +1,205 @@
+"""Kernel-level profiling: trace capture, cost attribution, and the
+timer self-check.
+
+Three instruments, all host-side:
+
+- :func:`capture` wraps ``jax.profiler`` trace capture around any
+  engine run (``run_cycles``, deep-engine steps) with the same
+  degrade-gracefully guard bench.py uses — some device plugins can't
+  profile, and a benchmark must never die because its profiler did.
+- :func:`kernel_cost_report` asks XLA what the compiled program
+  actually costs (flops / bytes accessed / transcendentals via
+  ``compiled.cost_analysis()``) and — through
+  ``PhaseTimer.attach("kernels", ...)`` — folds that attribution into
+  the same report as the wall-clock phases, so a phase split and its
+  kernel-level explanation travel together.
+- :func:`timer_self_check` re-asserts PERF.md's measurement lesson as
+  an executable check: over a tunneled device plugin,
+  ``jax.block_until_ready`` can return before the computation
+  finishes, silently turning "run time" into "dispatch time". The
+  check times the block barrier and then the scalar ``device_get``
+  tail behind it; a fat tail means the block barrier lied and only
+  the device_get numbers in this environment are trustworthy.
+"""
+# lint: host
+
+from __future__ import annotations
+
+import sys
+import time
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+import numpy as np
+
+#: a device_get tail longer than this (seconds) AND dominating the
+#: block barrier marks the barrier untrustworthy — generous against
+#: scheduler jitter, tiny against the ~90-130 ms tunnel sync tax
+_TAIL_BUDGET_S = 0.025
+
+
+# lint: host
+@contextmanager
+def capture(out_dir: Optional[str],
+            quiet: bool = False) -> Iterator[dict]:
+    """Guarded ``jax.profiler.trace`` capture into ``out_dir``.
+
+    Yields a status dict (``enabled``, and ``error`` when capture
+    failed or was disabled). ``out_dir=None`` is a no-op pass-through
+    so call sites don't need their own conditional.
+    """
+    status = {"enabled": False, "out_dir": out_dir, "error": None}
+    if not out_dir:
+        yield status
+        return
+    import jax
+    try:
+        ctx = jax.profiler.trace(out_dir)
+        ctx.__enter__()
+    except Exception as e:  # some device plugins can't profile
+        status["error"] = str(e)
+        if not quiet:
+            print(f"warning: profiler capture failed: {e}",
+                  file=sys.stderr)
+        yield status
+        return
+    status["enabled"] = True
+    try:
+        yield status
+    finally:
+        try:
+            ctx.__exit__(None, None, None)
+            if not quiet:
+                print(f"profiler trace written to {out_dir}",
+                      file=sys.stderr)
+        except Exception as e:
+            status["enabled"] = False
+            status["error"] = str(e)
+            if not quiet:
+                print(f"warning: profiler finalize failed: {e}",
+                      file=sys.stderr)
+
+
+# lint: host
+def _normalize_cost(cost) -> dict:
+    """cost_analysis() shapes vary by backend/version: a dict, a list
+    of dicts (one per computation), or None. Collapse to one flat
+    {metric: float} dict, summing across computations."""
+    if cost is None:
+        return {}
+    if isinstance(cost, dict):
+        parts = [cost]
+    elif isinstance(cost, (list, tuple)):
+        parts = [c for c in cost if isinstance(c, dict)]
+    else:
+        return {}
+    out: dict = {}
+    for part in parts:
+        for k, v in part.items():
+            try:
+                out[str(k)] = out.get(str(k), 0.0) + float(v)
+            except (TypeError, ValueError):
+                continue
+    return out
+
+
+# lint: host
+def kernel_cost_report(jitted, *args, **kwargs) -> dict:
+    """Compiled-cost attribution for one jitted callable at the given
+    (abstract) arguments.
+
+    Returns ``{"available": bool, "cost": {...}, "memory": {...}}`` —
+    ``cost`` holds XLA's flops / bytes-accessed / transcendentals
+    estimate, ``memory`` the compiled memory analysis when the backend
+    exposes it. ``available=False`` (never an exception) when the
+    backend supports neither: cost attribution is an instrument, not a
+    dependency.
+    """
+    rep = {"available": False, "cost": {}, "memory": {}}
+    try:
+        compiled = jitted.lower(*args, **kwargs).compile()
+    except Exception as e:
+        rep["error"] = str(e)
+        return rep
+    try:
+        rep["cost"] = _normalize_cost(compiled.cost_analysis())
+    except Exception:
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        for k in ("temp_size_in_bytes", "argument_size_in_bytes",
+                  "output_size_in_bytes", "generated_code_size_in_bytes"):
+            v = getattr(mem, k, None)
+            if v is not None:
+                rep["memory"][k] = int(v)
+    except Exception:
+        pass
+    rep["available"] = bool(rep["cost"] or rep["memory"])
+    return rep
+
+
+# lint: host
+def attach_kernel_costs(timer, jitted, *args, **kwargs) -> dict:
+    """kernel_cost_report folded into a PhaseTimer report (under the
+    "kernels" key)."""
+    rep = kernel_cost_report(jitted, *args, **kwargs)
+    timer.attach("kernels", rep)
+    return rep
+
+
+# lint: host
+def _scalar_sync(out) -> float:
+    """The real barrier: materialize one scalar on the host. Unlike
+    block_until_ready this cannot return before the bytes exist."""
+    import jax
+    leaves = [x for x in jax.tree_util.tree_leaves(out)
+              if hasattr(x, "shape")]
+    if not leaves:
+        return 0.0
+    return float(np.asarray(leaves[0]).ravel()[0])
+
+
+# lint: host
+def timer_self_check(fn, *args, reps: int = 3) -> dict:
+    """Is ``jax.block_until_ready`` a real barrier on this link?
+
+    Runs ``fn(*args)`` ``reps`` times (after one warmup), timing per
+    run: dispatch (call returns), block (``block_until_ready``
+    returns), then the device_get tail (first scalar materialized on
+    host). If the block barrier is honest the tail is bounded by host
+    copy cost; if it lies (PERF.md: tunneled device plugins), the
+    computation finishes inside the tail and the tail dominates.
+
+    Returns medians plus ``barrier_trustworthy`` — when False, only
+    device_get-synced timings from this environment should be
+    believed.
+    """
+    import jax
+    _scalar_sync(fn(*args))  # warmup: compile outside the measurement
+    dispatch, block, tail = [], [], []
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        t1 = time.perf_counter()
+        jax.block_until_ready(out)
+        t2 = time.perf_counter()
+        _scalar_sync(out)
+        t3 = time.perf_counter()
+        dispatch.append(t1 - t0)
+        block.append(t2 - t1)
+        tail.append(t3 - t2)
+    med = (lambda xs: sorted(xs)[len(xs) // 2])
+    d_med, b_med, t_med = med(dispatch), med(block), med(tail)
+    trustworthy = t_med <= max(_TAIL_BUDGET_S, 0.25 * b_med)
+    return {
+        "reps": max(1, reps),
+        "dispatch_s": round(d_med, 6),
+        "block_until_ready_s": round(b_med, 6),
+        "device_get_tail_s": round(t_med, 6),
+        "barrier_trustworthy": trustworthy,
+        "verdict": ("block_until_ready is a real barrier here"
+                    if trustworthy else
+                    "block_until_ready LIES on this link: the run "
+                    "completes inside the device_get tail; trust only "
+                    "device_get-synced timings (PERF.md)"),
+    }

@@ -347,9 +347,9 @@ def run_cycles_traced(cfg: SystemConfig, state: SimState,
     return final.replace(**ro), events
 
 
-@functools.partial(jax.jit, static_argnums=(0, 2))
+@functools.partial(jax.jit, static_argnums=(0, 2, 3))
 def run_cycles_telemetry(cfg: SystemConfig, state: SimState,
-                         num_cycles: int):
+                         num_cycles: int, message_phase=None):
     """Scan `num_cycles` cycles collecting the per-cycle telemetry.
 
     Returns (state, telem) with telem a dict of [num_cycles, ...]
@@ -358,11 +358,16 @@ def run_cycles_telemetry(cfg: SystemConfig, state: SimState,
     obs/timeseries.py. Shape-static: every sample is fixed-size, so
     the jit graph is independent of run length apart from the scan
     trip count.
+
+    ``message_phase`` is the same static handler-phase override `cycle`
+    takes — the flight recorder (obs/flight.py) uses it to capture
+    telemetry of the fuzzer's *mutated* engine runs.
     """
     carry0, ro, blanks = _ro_outside(state)
 
     def body(s, _):
-        out, tel = cycle(cfg, s.replace(**ro), with_telemetry=True)
+        out, tel = cycle(cfg, s.replace(**ro), with_telemetry=True,
+                         message_phase=message_phase)
         return out.replace(**blanks), tel
 
     final, telem = jax.lax.scan(body, carry0, None, length=num_cycles)
