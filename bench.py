@@ -94,6 +94,14 @@ def main():
     ap.add_argument("--admission", type=int, default=None,
                     help="async engine: max concurrent outstanding "
                          "requests (None = reference drop semantics)")
+    ap.add_argument("--ledger", action="store_true",
+                    help="async engine: measure the run under the "
+                         "causal message-ledger capture "
+                         "(obs.txntrace.capture: ledger-on telemetry "
+                         "scans + per-chunk host fetch) — the "
+                         "transaction-tracer overhead bench; compare "
+                         "against a plain async capture with "
+                         "bench-diff (PERF.md)")
     ap.add_argument("--reps", type=int, default=3,
                     help="timed repetitions; the median is reported")
     ap.add_argument("--procedural", default=True,
@@ -298,6 +306,29 @@ def main():
         def steps(st):
             return int(st.metrics.cycles)
 
+    if args.ledger:
+        if args.engine != "async":
+            print("error: --ledger measures the async engine's "
+                  "message-ledger capture; use --engine async",
+                  file=sys.stderr)
+            return 2
+        if args.sharded:
+            print("error: --ledger and --sharded are exclusive "
+                  "(use parallel.make_sharded_ledger_runner for "
+                  "sharded capture)", file=sys.stderr)
+            return 2
+        from ue22cs343bb1_openmp_assignment_tpu.obs import txntrace
+        # the ledger replay runs a fixed cycle count: find this
+        # workload's cycles-to-quiescence once, ledger off
+        ledger_cycles = steps(run_chunked_to_quiescence(
+            cfg, st0, args.chunk, max_cycles))
+
+        def runner(s):
+            final, _, _ = txntrace.capture(
+                cfg, s, ledger_cycles, chunk=args.chunk,
+                stop_on_quiescence=False)
+            return final
+
     n_dev = 1
     if args.sharded:
         # multi-chip mode: the node axis shards over every attached
@@ -371,6 +402,10 @@ def main():
     value = retired / elapsed
     rep = (f", {args.replicas} replicas" if args.replicas > 1 else "")
     rep += ", procedural" if args.procedural else ""
+    # the ledger marker rides the history label + config fingerprint,
+    # NOT the metric string: bench-diff matches on the metric, and
+    # plain-vs-ledger is exactly the comparison that measures the
+    # tracer's overhead
     result = {
         "metric": f"simulated RD/WR instrs/sec @{args.nodes} cores "
                   f"({args.engine} engine, {args.workload}{rep}, 1 chip, "
@@ -412,11 +447,14 @@ def main():
             "max_cycles": max_cycles, "replicas": args.replicas,
             "procedural": bool(args.procedural and sync_like),
             "sharded": bool(args.sharded), "devices": n_dev,
+            "ledger": bool(args.ledger),
             "platform": jax.devices()[0].platform,
             "smoke": bool(args.smoke),
         }
         doc = history.entry(
-            label=f"{args.engine}@{args.nodes}", source="bench.py",
+            label=(f"{args.engine}@{args.nodes}"
+                   + ("+ledger" if args.ledger else "")),
+            source="bench.py",
             result=result, extra=extra, config=fingerprint,
             sha=history.git_sha(os.path.dirname(
                 os.path.abspath(__file__))),

@@ -18,8 +18,12 @@
 # in the same invocation.
 #
 # The obs smoke step runs `cache-sim stats` on the mini fixture and
-# validates the emitted report against the cache-sim/metrics/v1 schema
-# (the golden comparison lives in tests/test_obs.py).
+# validates the emitted report against the cache-sim/metrics/v1.1
+# schema (the golden comparison lives in tests/test_obs.py). The txn
+# smoke replays the same fixture under the message ledger: every
+# reconstructed span's segment decomposition must sum exactly to its
+# end-to-end latency, and two `cache-sim critical-path` runs must emit
+# byte-identical reports (the tracer is deterministic by contract).
 #
 # The bench-smoke gate exercises the noise-aware regression harness
 # end to end: the archived r03/r04 captures must classify as noise
@@ -46,6 +50,25 @@ assert doc["engine"] == "async" and doc["instrs_retired"] > 0
 print("obs smoke: ok (schema", doc["schema"] + ",",
       doc["instrs_retired"], "instrs)")
 PY
+
+python -m ue22cs343bb1_openmp_assignment_tpu.cli txns mini \
+    --tests-root tests/fixtures --json --out /tmp/_txn_smoke.json
+python - <<'PY'
+import json
+doc = json.load(open("/tmp/_txn_smoke.json"))
+assert doc["schema"] == "cache-sim/txnspans/v1"
+assert doc["spans_closed"] > 0
+for s in doc["slowest"]:
+    assert sum(s["segments"].values()) == s["e2e"], s
+print("txn smoke: ok (" + str(doc["spans_closed"]), "spans,",
+      str(doc["attributed"]), "attributed)")
+PY
+python -m ue22cs343bb1_openmp_assignment_tpu.cli critical-path mini \
+    --tests-root tests/fixtures --json --out /tmp/_cp_smoke_a.json
+python -m ue22cs343bb1_openmp_assignment_tpu.cli critical-path mini \
+    --tests-root tests/fixtures --json --out /tmp/_cp_smoke_b.json
+cmp /tmp/_cp_smoke_a.json /tmp/_cp_smoke_b.json
+echo "critical-path smoke: ok (deterministic)"
 
 python -m ue22cs343bb1_openmp_assignment_tpu.cli bench-diff \
     BENCH_r03.json BENCH_r04.json

@@ -609,6 +609,12 @@ def main(argv=None) -> int:
     if raw[:1] == ["bench-diff"]:
         from ue22cs343bb1_openmp_assignment_tpu.obs import cli as obs_cli
         return obs_cli.main_bench_diff(raw[1:])
+    if raw[:1] == ["txns"]:
+        from ue22cs343bb1_openmp_assignment_tpu.obs import cli as obs_cli
+        return obs_cli.main_txns(raw[1:])
+    if raw[:1] == ["critical-path"]:
+        from ue22cs343bb1_openmp_assignment_tpu.obs import cli as obs_cli
+        return obs_cli.main_critpath(raw[1:])
     args = build_parser().parse_args(raw)
     if args.cpu:
         import jax

@@ -11,6 +11,16 @@ per-cycle series can be written aside with ``--timeseries-out``.
 Chrome/Perfetto trace-event JSON (obs.perfetto): per-node ``instr``
 and ``msg`` tracks from the async engine, retirement tracks from the
 sync/deep engine. Open the file in ui.perfetto.dev.
+
+``txns`` replays a run under the message ledger (ops.step
+with_ledger) and prints the causal transaction spans (obs.txntrace):
+the per-type latency decomposition table and the top-N slowest
+transactions; ``--perfetto OUT`` additionally writes the event trace
+with flow arrows linking each transaction's request/reply slices.
+``critical-path`` runs the happens-before analysis (obs.critpath) and
+prints the critical path to quiescence with per-node / per-phase cycle
+attribution. Both are async-engine surfaces (the ledger is a
+message-plane capture) and deterministic for a fixed config.
 """
 # lint: host
 
@@ -56,6 +66,10 @@ def build_stats_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeseries", action="store_true",
                    help="async engine: capture the on-device per-cycle "
                         "telemetry and attach a summary under extra")
+    p.add_argument("--txns", action="store_true",
+                   help="async engine: replay under the message ledger "
+                        "and attach the transaction-span latency "
+                        "summary as the v1.1 txn_latency block")
     p.add_argument("--timeseries-out", metavar="PATH",
                    help="also write the full per-cycle series JSON "
                         "(implies --timeseries)")
@@ -120,15 +134,15 @@ def cmd_stats(args) -> int:
     want_ts = args.timeseries or args.timeseries_out
 
     if args.engine == "native":
-        if want_ts:
-            print("error: --timeseries is on-device capture; use "
-                  "--engine async", file=sys.stderr)
+        if want_ts or args.txns:
+            print("error: --timeseries/--txns are on-device capture; "
+                  "use --engine async", file=sys.stderr)
             return 2
         doc = _stats_native(args, timer)
     elif args.engine == "sync":
-        if want_ts:
-            print("error: --timeseries needs the message-level engine; "
-                  "use --engine async", file=sys.stderr)
+        if want_ts or args.txns:
+            print("error: --timeseries/--txns need the message-level "
+                  "engine; use --engine async", file=sys.stderr)
             return 2
         doc = _stats_sync(args, timer)
     else:
@@ -172,6 +186,19 @@ def _stats_async(args, timer, want_ts: bool):
             with open(args.timeseries_out, "w") as f:
                 json.dump(timeseries.to_series(telem), f)
                 f.write("\n")
+    if args.txns:
+        import numpy as np
+
+        from ue22cs343bb1_openmp_assignment_tpu.obs import txntrace
+        # same replay discipline as --timeseries, ledger on
+        with timer.phase("ledger_run"):
+            _, ledger, base = txntrace.capture(
+                system0.cfg, system0.state, int(m["cycles"]),
+                stop_on_quiescence=False)
+        spans, _ = txntrace.reconstruct(
+            system0.cfg, ledger, base,
+            arb_rank=np.asarray(system0.state.arb_rank))
+        doc["txn_latency"] = txntrace.summarize(spans)
     return doc
 
 
@@ -293,6 +320,220 @@ def cmd_trace(args) -> int:
           f"{num_nodes} nodes (open in ui.perfetto.dev)",
           file=sys.stderr)
     return 0
+
+
+# lint: host
+def build_txns_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cache-sim txns",
+        description="replay a run under the message ledger and print "
+                    "the causal transaction spans: per-type latency "
+                    "decomposition (queue_wait/dir_service/in_flight/"
+                    "ack_wait) and the slowest transactions")
+    _add_common(p)
+    p.add_argument("--top", type=int, default=10,
+                   help="how many slowest transactions to show "
+                        "(default 10)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the full cache-sim/txnspans/v1 document "
+                        "instead of the text tables")
+    p.add_argument("--perfetto", metavar="PATH",
+                   help="also write the event trace with flow arrows "
+                        "linking each transaction's request/reply "
+                        "slices")
+    p.add_argument("--out", metavar="PATH",
+                   help="write the report here instead of stdout")
+    return p
+
+
+# lint: host
+def build_critpath_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cache-sim critical-path",
+        description="compute the critical path to quiescence over the "
+                    "run's happens-before DAG and attribute every "
+                    "cycle on it to a (node, phase) pair")
+    _add_common(p)
+    p.add_argument("--json", action="store_true",
+                   help="emit the full cache-sim/critpath/v1 report")
+    p.add_argument("--out", metavar="PATH",
+                   help="write the report here instead of stdout")
+    return p
+
+
+# lint: host
+def _capture_spans(args):
+    """Build the async system, find the run length, and replay exactly
+    that many cycles under the message ledger. Returns
+    (spans, trace, total_cycles, ledger, cfg) or None when no input
+    was given.
+
+    Two-pass on purpose: the plain run (ledger off) finds the cycles-
+    to-quiescence T cheaply, then the ledger replay runs exactly T
+    cycles with stop_on_quiescence=False — so the captured window is
+    independent of the capture chunk size and the output is
+    deterministic for a fixed config.
+    """
+    import numpy as np
+
+    from ue22cs343bb1_openmp_assignment_tpu.obs import txntrace
+    system0 = _async_system(args)
+    if system0 is None:
+        return None
+    if args.run_cycles is not None:
+        total = int(args.run_cycles)
+    else:
+        done = system0.run(args.max_cycles)
+        total = int(done.metrics["cycles"])
+    _, ledger, base = txntrace.capture(
+        system0.cfg, system0.state, total, stop_on_quiescence=False)
+    spans, trace = txntrace.reconstruct(
+        system0.cfg, ledger, base,
+        arb_rank=np.asarray(system0.state.arb_rank))
+    return spans, trace, total, ledger, system0.cfg
+
+
+# lint: host
+def _render_txns(spans, total: int, top: int) -> str:
+    from ue22cs343bb1_openmp_assignment_tpu.obs import txntrace
+    lines = [f"transaction spans: {len(spans)} total over {total} "
+             f"cycles"]
+    table = txntrace.latency_table(spans)
+    if table:
+        lines.append("")
+        lines.append(f"{'type':<12} {'count':>5} {'p50':>5} {'p95':>5} "
+                     f"{'p99':>5} {'max':>5} {'mean':>7}")
+        for t in sorted(table):
+            r = table[t]
+            lines.append(f"{t:<12} {r['count']:>5} {r['p50']:>5} "
+                         f"{r['p95']:>5} {r['p99']:>5} {r['max']:>5} "
+                         f"{r['mean']:>7.2f}")
+        lines.append("")
+        lines.append(f"{'type':<12} " + " ".join(
+            f"{s:>12}" for s in txntrace.SEGMENTS))
+        for t in sorted(table):
+            segs = table[t]["segments"]
+            lines.append(f"{t:<12} " + " ".join(
+                f"{segs[s]['total']:>12}" for s in txntrace.SEGMENTS))
+    slow = txntrace.top_slowest(spans, top)
+    if slow:
+        lines.append("")
+        lines.append(f"slowest {len(slow)}:")
+        for s in slow:
+            segs = s["segments"]
+            seg_txt = " ".join(f"{k}={segs[k]}"
+                               for k in txntrace.SEGMENTS if segs[k])
+            tag = "" if s["attributed"] else " [unattributed]"
+            lines.append(
+                f"  n{s['requester']} 0x{s['addr']:02X} "
+                f"{s['type']:<10} issue@{s['t_issue']:>5} "
+                f"e2e={s['e2e']:>4}  {seg_txt}{tag}")
+    open_spans = [s for s in spans if s["t_end"] is None]
+    if open_spans:
+        lines.append("")
+        lines.append(f"open at capture end: {len(open_spans)}")
+    return "\n".join(lines) + "\n"
+
+
+# lint: host
+def cmd_txns(args) -> int:
+    from ue22cs343bb1_openmp_assignment_tpu.obs import perfetto, txntrace
+    res = _capture_spans(args)
+    if res is None:
+        print("error: provide <test_directory> or --workload",
+              file=sys.stderr)
+        return 2
+    spans, trace, total, ledger, cfg = res
+    if args.perfetto:
+        records = txntrace.ledger_to_records(ledger,
+                                             trace["base_cycle"])
+        flows = perfetto.span_flow_events(spans)
+        doc = perfetto.build_trace(records, cfg.num_nodes, flows=flows)
+        perfetto.validate_trace(doc)
+        perfetto.write_trace(args.perfetto, doc)
+        print(f"wrote {args.perfetto}: {len(records)} events, "
+              f"{len(flows)} flow arrows", file=sys.stderr)
+    if args.json:
+        _emit(args, txntrace.spans_doc(cfg, spans, total,
+                                       top=args.top))
+    else:
+        text = _render_txns(spans, total, args.top)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text)
+        else:
+            sys.stdout.write(text)
+    return 0
+
+
+# lint: host
+def _render_critpath(report, hot) -> str:
+    total = report["total_cycles"]
+    pct = (f" ({100.0 * report['length'] / total:.0f}% of "
+           f"{total} cycles)" if total else "")
+    lines = [f"critical path: {report['length']} cycles"
+             f"{pct}, {report['events_on_path']} events"]
+    if report["start"]:
+        s, e = report["start"], report["end"]
+        lines.append(f"  {s['kind']}@n{s['node']} cycle {s['cycle']} "
+                     f"-> {e['kind']}@n{e['node']} cycle {e['cycle']}")
+    lines.append("")
+    lines.append("by phase:")
+    for ph, c in report["by_phase"].items():
+        if c:
+            lines.append(f"  {ph:<14} {c:>6}")
+    lines.append("by node:")
+    for n, c in report["by_node"].items():
+        lines.append(f"  node {n:<9} {c:>6}")
+    if hot:
+        lines.append("")
+        lines.append("hotspots (largest waits on the path):")
+        for s in hot:
+            what = (f"{s['msg']['type']} from n{s['msg']['src']}"
+                    if "msg" in s else "program order")
+            lines.append(f"  cycle {s['cycle']:>5} n{s['node']}: "
+                         f"waited {s['wait']} ({what})")
+    return "\n".join(lines) + "\n"
+
+
+# lint: host
+def cmd_critpath(args) -> int:
+    from ue22cs343bb1_openmp_assignment_tpu.obs import critpath
+    res = _capture_spans(args)
+    if res is None:
+        print("error: provide <test_directory> or --workload",
+              file=sys.stderr)
+        return 2
+    _, trace, total, _, _ = res
+    report = critpath.critical_path(trace, total_cycles=total)
+    if args.json:
+        _emit(args, report)
+    else:
+        text = _render_critpath(report, critpath.hotspots(report))
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text)
+        else:
+            sys.stdout.write(text)
+    return 0
+
+
+# lint: host
+def main_txns(argv) -> int:
+    args = build_txns_parser().parse_args(argv)
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    return cmd_txns(args)
+
+
+# lint: host
+def main_critpath(argv) -> int:
+    args = build_critpath_parser().parse_args(argv)
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    return cmd_critpath(args)
 
 
 # lint: host

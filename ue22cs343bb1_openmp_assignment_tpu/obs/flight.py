@@ -10,7 +10,11 @@ by hand. The flight recorder turns each of those into a self-contained
   validated ``cache-sim/metrics/v1`` doc of the final state, and the
   ring buffer of the last K cycles of telemetry (per-cycle counter
   deltas, queue watermarks, directory occupancy — the same on-device
-  series behind ``cache-sim stats --timeseries``);
+  series behind ``cache-sim stats --timeseries``), plus a
+  ``txn_summary`` from the causal tracer (obs.txntrace): the slowest
+  five transactions of the incident's tail with their latency
+  decomposition and every transaction still in flight when the
+  recorder stopped;
 - ``trace.perfetto.json`` — a validated Perfetto event trace of the
   run replayed from the initial state (the engine is deterministic, so
   the replay IS the incident);
@@ -157,6 +161,16 @@ class FlightRecorder:
 
         ring = self.ring()
         series = timeseries.to_series(ring) if ring else None
+        # causal transaction spans of the incident's tail: the slowest
+        # closed transactions with their latency decomposition plus
+        # everything still in flight when the recorder stopped — the
+        # hang suspects, by name
+        txn_summary = None
+        if self.cycles_run:
+            from ue22cs343bb1_openmp_assignment_tpu.obs import txntrace
+            txn_summary = txntrace.incident_summary(
+                self.cfg, self.state0, self.cycles_run,
+                self.message_phase)
         doc = {
             "schema": SCHEMA_ID,
             "reason": str(reason),
@@ -169,6 +183,7 @@ class FlightRecorder:
             "ring_summary": (timeseries.summarize(ring)
                              if ring else None),
             "metrics": self._metrics_doc(),
+            "txn_summary": txn_summary,
             "trace_cycles": n_trace,
             "has_repro": case is not None,
             "files": sorted(files),

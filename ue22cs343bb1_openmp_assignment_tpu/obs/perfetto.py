@@ -27,7 +27,13 @@ from ue22cs343bb1_openmp_assignment_tpu.types import Op
 TID_INSTR = 0
 TID_MSG = 1
 
-_PHASES = ("X", "B", "E", "I", "M", "C")
+_PHASES = ("X", "B", "E", "I", "M", "C", "s", "t", "f")
+
+#: flow-event phases (ph "s" start / "t" step / "f" finish) — emitted
+#: by span_flow_events to link one transaction's request/reply slices
+#: across node tracks; each binds to the X slice sharing its
+#: (pid, tid, ts)
+_FLOW_PHASES = ("s", "t", "f")
 
 
 # lint: host
@@ -68,11 +74,45 @@ def record_to_event(rec: dict) -> dict:
 
 
 # lint: host
-def build_trace(records: List[dict], num_nodes: int) -> dict:
+def span_flow_events(spans: List[dict]) -> List[dict]:
+    """Transaction spans (obs.txntrace) → Perfetto flow events linking
+    each span's request/reply slices across node tracks.
+
+    Per attributed closed span: a flow *start* ("s") on the issuing
+    instruction slice at the requester, a *step* ("t") on each
+    intermediate hop's dequeue slice, and a *finish* ("f", binding
+    enclosing, so it attaches to the final reply's dequeue slice back
+    at the requester). Flow ids are the span's position in the input
+    list — stable because span order is reconstruction order.
+    """
+    out = []
+    for fid, s in enumerate(spans):
+        if not s.get("attributed") or not s.get("chain"):
+            continue
+        name = (f"txn n{s['requester']} 0x{s['addr']:02X} "
+                f"#{s['seq']}")
+        common = {"name": name, "cat": "txn", "id": fid}
+        out.append({"ph": "s", "pid": s["requester"],
+                    "tid": TID_INSTR, "ts": s["t_issue"], **common})
+        for hop in s["chain"][:-1]:
+            out.append({"ph": "t", "pid": hop["dst"], "tid": TID_MSG,
+                        "ts": hop["deq"], **common})
+        last = s["chain"][-1]
+        out.append({"ph": "f", "bp": "e", "pid": last["dst"],
+                    "tid": TID_MSG, "ts": last["deq"], **common})
+    return out
+
+
+# lint: host
+def build_trace(records: List[dict], num_nodes: int,
+                flows: List[dict] = None) -> dict:
     """Records (utils.eventlog.to_records / sync_to_records) → a
-    complete trace-event JSON document."""
+    complete trace-event JSON document. ``flows`` (span_flow_events)
+    are appended after the slices they bind to."""
     events = track_metadata(num_nodes)
     events.extend(record_to_event(r) for r in records)
+    if flows:
+        events.extend(flows)
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "otherData": {"source": "cache-sim", "time_unit": "cycle"}}
 
@@ -114,6 +154,15 @@ def validate_trace(doc: dict) -> dict:
                 errs.append(f"event {i}: X event missing dur")
             if not isinstance(ev.get("tid"), int):
                 errs.append(f"event {i}: X event missing tid")
+        if ph in _FLOW_PHASES:
+            if not isinstance(ev.get("ts"), (int, float)):
+                errs.append(f"event {i}: flow event missing ts")
+            if not isinstance(ev.get("tid"), int):
+                errs.append(f"event {i}: flow event missing tid")
+            if not isinstance(ev.get("id"), (int, str)):
+                errs.append(f"event {i}: flow event missing id")
+            if not isinstance(ev.get("cat"), str):
+                errs.append(f"event {i}: flow event missing cat")
         if ph == "M" and "args" not in ev:
             errs.append(f"event {i}: M event missing args")
     if errs:

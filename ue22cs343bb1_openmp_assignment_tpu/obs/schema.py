@@ -1,4 +1,4 @@
-"""The unified cross-engine metrics schema: ``cache-sim/metrics/v1``.
+"""The unified cross-engine metrics schema: ``cache-sim/metrics/v1.1``.
 
 Before this module each engine's ``--metrics`` dump had its own shape
 (async: the raw Metrics pytree, sync: a hand-picked field subset,
@@ -14,7 +14,7 @@ the producing engine does not measure — *not* zero):
 ==================== ====================================================
 key                  meaning
 ==================== ====================================================
-schema               literal ``"cache-sim/metrics/v1"``
+schema               literal ``"cache-sim/metrics/v1.1"``
 engine               producing engine (``async``/``sync``/``deep``/
                      ``native``)
 steps                engine time steps executed
@@ -31,11 +31,21 @@ latency_cycles       {bucket_lo, counts}: miss-latency histogram,
                      [bucket_lo[b], next lo); last bucket open-ended
 extra                engine-specific counters that have no cross-engine
                      meaning (e.g. sync conflicts/promotions)
+txn_latency          *optional* (v1.1): transaction-span latency summary
+                     from the causal tracer (obs.txntrace.summarize):
+                     {spans, open, by_type: {type: {count, p50, p95,
+                     p99}}, segments_total} — async engine with the
+                     message ledger on (``cache-sim stats --txns``)
 ==================== ====================================================
 
 The eight core counters stay flat at top level on purpose: pre-existing
 tooling (and tests/test_cli_engines.py) reads
 ``metrics["instrs_retired"]`` directly.
+
+v1 → v1.1: the only change is the optional ``txn_latency`` block.
+:func:`validate` accepts v1 documents unchanged (a v1 doc carrying
+``txn_latency`` is rejected — the key did not exist in v1), so every
+archived report and golden keeps validating.
 """
 
 from __future__ import annotations
@@ -44,7 +54,10 @@ from typing import Optional
 
 from ue22cs343bb1_openmp_assignment_tpu.types import MSG_NAMES
 
-SCHEMA_ID = "cache-sim/metrics/v1"
+SCHEMA_ID = "cache-sim/metrics/v1.1"
+
+#: the previous schema id; validate() accepts docs under either
+SCHEMA_V1 = "cache-sim/metrics/v1"
 
 #: the eight cross-engine core counters, flat at top level of the report
 CORE_COUNTERS = ("instrs_retired", "read_hits", "write_hits",
@@ -53,6 +66,12 @@ CORE_COUNTERS = ("instrs_retired", "read_hits", "write_hits",
 
 _TOP_KEYS = (("schema", "engine", "steps", "step_unit") + CORE_COUNTERS
              + ("messages", "queue_depth_peak", "latency_cycles", "extra"))
+
+#: v1.1 optional keys: allowed but never required
+_OPT_KEYS = ("txn_latency",)
+
+#: required fields of each txn_latency by_type entry
+_TXN_TYPE_KEYS = ("count", "p50", "p95", "p99")
 
 _MSG_KEYS = ("processed_total", "by_type", "dropped_overflow",
              "dropped_injected")
@@ -148,21 +167,55 @@ def coverage_signature(doc: dict, dir_occupancy: Optional[dict] = None):
 
 
 # lint: host
+def _validate_txn_latency(tl, errs) -> None:
+    """Structural check of the optional v1.1 txn_latency block."""
+    if not isinstance(tl, dict):
+        errs.append("txn_latency must be a dict")
+        return
+    for k in ("spans", "open"):
+        v = tl.get(k)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errs.append(f"txn_latency.{k} must be a non-negative int, "
+                        f"got {v!r}")
+    bt = tl.get("by_type")
+    if not isinstance(bt, dict):
+        errs.append("txn_latency.by_type must be a dict")
+    else:
+        for t, ent in bt.items():
+            if (not isinstance(ent, dict)
+                    or any(k not in ent for k in _TXN_TYPE_KEYS)):
+                errs.append(f"txn_latency.by_type[{t!r}] must carry "
+                            f"{_TXN_TYPE_KEYS}")
+    st = tl.get("segments_total")
+    if not isinstance(st, dict) or not all(
+            isinstance(v, int) and v >= 0 for v in st.values()):
+        errs.append("txn_latency.segments_total must be a dict of "
+                    "non-negative ints")
+
+
+# lint: host
 def validate(doc: dict) -> dict:
-    """Check a report against the v1 schema; returns the doc, raises
-    ValueError listing every violation. Dependency-free on purpose —
-    the container has no jsonschema."""
+    """Check a report against the schema (v1.1, or v1 unchanged for
+    backward compatibility); returns the doc, raises ValueError
+    listing every violation. Dependency-free on purpose — the
+    container has no jsonschema."""
     errs = []
     if not isinstance(doc, dict):
         raise ValueError(f"report must be a dict, got {type(doc).__name__}")
+    is_v1 = doc.get("schema") == SCHEMA_V1
+    allowed = _TOP_KEYS if is_v1 else _TOP_KEYS + _OPT_KEYS
     for k in _TOP_KEYS:
         if k not in doc:
             errs.append(f"missing key: {k}")
     for k in doc:
-        if k not in _TOP_KEYS:
+        if k not in allowed:
             errs.append(f"unknown key: {k}")
-    if doc.get("schema") != SCHEMA_ID:
-        errs.append(f"schema must be {SCHEMA_ID!r}, got {doc.get('schema')!r}")
+    if doc.get("schema") not in (SCHEMA_ID, SCHEMA_V1):
+        errs.append(f"schema must be {SCHEMA_ID!r} (or the "
+                    f"backward-compatible {SCHEMA_V1!r}), "
+                    f"got {doc.get('schema')!r}")
+    if "txn_latency" in doc and not is_v1:
+        _validate_txn_latency(doc["txn_latency"], errs)
     if not isinstance(doc.get("engine"), str):
         errs.append("engine must be a string")
     if doc.get("step_unit") not in ("cycles", "rounds"):

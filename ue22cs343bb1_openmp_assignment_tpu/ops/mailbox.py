@@ -140,7 +140,7 @@ def segment_ranks(bucket, valid):
 
 
 def deliver(cfg: SystemConfig, state, cand: Candidates, arb_rank,
-            new_head, new_count):
+            new_head, new_count, *, with_accept: bool = False):
     """Scatter candidates into the rings with deterministic arbitration.
 
     arb_rank: [N] i32 permutation of node ids — the seedable stand-in for
@@ -153,7 +153,13 @@ def deliver(cfg: SystemConfig, state, cand: Candidates, arb_rank,
     (``assignment.c:754-762``) as a stress knob for the stall watchdog
     (ops.failures).
 
-    Returns (state updates dict, dropped_count, injected_count).
+    Returns (state updates dict, dropped_count, injected_count). With
+    ``with_accept=True`` the updates dict additionally carries
+    ``enq_accept``: the final per-candidate accept mask scattered back
+    to the original [N, S] slot layout — the message-ledger capture
+    (ops.step with_ledger) consumes it; the caller must pop it before
+    state.replace. Off by default so the headline path lowers to the
+    exact same HLO.
     """
     N, S, Q = cfg.num_nodes, cfg.out_slots, cfg.queue_capacity
     F = N * S
@@ -224,6 +230,11 @@ def deliver(cfg: SystemConfig, state, cand: Candidates, arb_rank,
             accept.astype(jnp.int32), mode="drop"),
         fault_key=fault_key,
     )
+    if with_accept:
+        # undo the arbitration sort: accept[i] belongs to candidate
+        # order[i], so one scatter restores the (node, slot) layout
+        acc = jnp.zeros((F,), jnp.bool_).at[order].set(accept)
+        updates["enq_accept"] = acc.reshape(N, S)
     return updates, dropped_overflow, injected
 
 

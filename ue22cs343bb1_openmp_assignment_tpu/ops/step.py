@@ -43,9 +43,20 @@ TELEMETRY_COUNTERS = ("instrs_retired", "read_hits", "write_hits",
                       "invalidations", "evictions")
 
 
+#: field layout of the per-cycle message-ledger sample
+#: (cycle(with_ledger=True) / run_cycles_telemetry(..., with_ledger=
+#: True)): per-node dequeue record, per-(node, slot) enqueue record
+#: with the post-arbitration accept mask, the frontend issue/latch
+#: record and the wait-clear mask — everything obs/txntrace.py needs
+#: to reconstruct causal transaction spans host-side
+LEDGER_FIELDS = ("deq_has", "deq_sender", "deq_type", "deq_addr",
+                 "enq_accept", "enq_type", "enq_recv", "enq_addr",
+                 "fetch", "issue", "op", "addr", "value", "unblocked")
+
+
 def cycle(cfg: SystemConfig, state: SimState,
           with_events: bool = False, message_phase=None,
-          with_telemetry: bool = False):
+          with_telemetry: bool = False, with_ledger: bool = False):
     """Advance the whole machine by one cycle.
 
     Cross-sender arbitration order for this cycle's deliveries comes from
@@ -71,6 +82,15 @@ def cycle(cfg: SystemConfig, state: SimState,
     all fixed-shape device scalars/vectors, so lax.scan stacks them
     into a time-series without leaving the jit graph. With both event
     and telemetry capture on, the return is ``(state, events, telem)``.
+
+    ``with_ledger=True`` additionally returns this cycle's message
+    ledger (LEDGER_FIELDS): the per-node dequeue record, the full
+    per-(node, slot) enqueue candidate planes plus their final accept
+    mask (mailbox.deliver with_accept), the frontend issue/latch record
+    and the wait-clear mask. Fixed-shape like telemetry, so the scan
+    stacks it in the same single dispatch; obs/txntrace.py reconstructs
+    causal transaction spans from it host-side. Output order with every
+    capture on: ``(state, events, telem, ledger)``.
     """
     if message_phase is None:
         message_phase = handlers.message_phase
@@ -187,7 +207,9 @@ def cycle(cfg: SystemConfig, state: SimState,
 
     # ---- phase 3: delivery -----------------------------------------------
     mb_upd, dropped, injected = mailbox.deliver(cfg, state, cand, arb_rank,
-                                                new_head, new_count)
+                                                new_head, new_count,
+                                                with_accept=with_ledger)
+    enq_accept = mb_upd.pop("enq_accept", None)
 
     # Vectorized INV application (scale path; reference assumes INV never
     # fails and tracks no acks, assignment.c:358-361). The broadcast for
@@ -260,7 +282,7 @@ def cycle(cfg: SystemConfig, state: SimState,
         cur_op=cur_op, cur_addr=cur_addr, cur_val=cur_val, waiting=waiting,
         waiting_since=waiting_since,
         cycle=state.cycle + 1, metrics=metrics, **mb_upd)
-    if not with_events and not with_telemetry:
+    if not with_events and not with_telemetry and not with_ledger:
         return new_state
     out = (new_state,)
     if with_events:
@@ -296,6 +318,39 @@ def cycle(cfg: SystemConfig, state: SimState,
             "waiting_nodes": jnp.sum(waiting).astype(jnp.int32),
         }
         out = out + (telem,)
+    if with_ledger:
+        # one fixed-shape sample per cycle (LEDGER_FIELDS); everything
+        # below is a value the cycle already computed — the only extra
+        # work is deliver's accept-mask un-permute scatter plus the
+        # narrowing casts: the scan stacks T of these samples, so the
+        # stacking bytes are the ledger's dominant cost and every
+        # field has a small static range (types < 14, node ids < N,
+        # addresses <= invalid_address)
+        n_dt = (jnp.int8 if cfg.num_nodes <= 127 else
+                jnp.int16 if cfg.num_nodes <= 32767 else jnp.int32)
+        a_dt = (jnp.int16 if cfg.invalid_address <= 32767
+                else jnp.int32)
+        ledger = {
+            # phase-1 dequeue record (masked by deq_has)
+            "deq_has": mv.has_msg,
+            "deq_sender": mv.sender.astype(n_dt),
+            "deq_type": mv.type.astype(jnp.int8),
+            "deq_addr": mv.addr.astype(a_dt),
+            # phase-3 enqueue record: candidate planes + final accept
+            # mask in (sender, program-order-slot) layout
+            "enq_accept": enq_accept,
+            "enq_type": c_type.astype(jnp.int8),
+            "enq_recv": c_recv.astype(n_dt),
+            "enq_addr": c_addr.astype(a_dt),
+            # phase-2 frontend record: fetch latch and whether this
+            # fetch opened a coherence wait (miss/upgrade = txn issue)
+            "fetch": fetch, "issue": f_upd["wait_set"],
+            "op": l_op.astype(jnp.int8),
+            "addr": l_addr.astype(a_dt), "value": l_val,
+            # wait cleared this cycle (span end)
+            "unblocked": m_stats["unblocked"],
+        }
+        out = out + (ledger,)
     return out
 
 
@@ -347,9 +402,10 @@ def run_cycles_traced(cfg: SystemConfig, state: SimState,
     return final.replace(**ro), events
 
 
-@functools.partial(jax.jit, static_argnums=(0, 2, 3))
+@functools.partial(jax.jit, static_argnums=(0, 2, 3, 4))
 def run_cycles_telemetry(cfg: SystemConfig, state: SimState,
-                         num_cycles: int, message_phase=None):
+                         num_cycles: int, message_phase=None,
+                         with_ledger: bool = False):
     """Scan `num_cycles` cycles collecting the per-cycle telemetry.
 
     Returns (state, telem) with telem a dict of [num_cycles, ...]
@@ -362,8 +418,24 @@ def run_cycles_telemetry(cfg: SystemConfig, state: SimState,
     ``message_phase`` is the same static handler-phase override `cycle`
     takes — the flight recorder (obs/flight.py) uses it to capture
     telemetry of the fuzzer's *mutated* engine runs.
+
+    ``with_ledger=True`` (static) stacks the per-cycle message ledger
+    alongside and returns ``(state, telem, ledger)`` — still ONE
+    device dispatch per call; the ledger planes ride the same scan.
+    obs/txntrace.py captures this in host-side chunks.
     """
     carry0, ro, blanks = _ro_outside(state)
+
+    if with_ledger:
+        def body(s, _):
+            out, tel, led = cycle(cfg, s.replace(**ro),
+                                  with_telemetry=True, with_ledger=True,
+                                  message_phase=message_phase)
+            return out.replace(**blanks), (tel, led)
+
+        final, (telem, ledger) = jax.lax.scan(body, carry0, None,
+                                              length=num_cycles)
+        return final.replace(**ro), telem, ledger
 
     def body(s, _):
         out, tel = cycle(cfg, s.replace(**ro), with_telemetry=True,
@@ -372,6 +444,28 @@ def run_cycles_telemetry(cfg: SystemConfig, state: SimState,
 
     final, telem = jax.lax.scan(body, carry0, None, length=num_cycles)
     return final.replace(**ro), telem
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2, 3))
+def run_cycles_ledger(cfg: SystemConfig, state: SimState,
+                      num_cycles: int, message_phase=None):
+    """Scan `num_cycles` cycles collecting ONLY the message ledger.
+
+    Same capture as ``run_cycles_telemetry(..., with_ledger=True)``
+    minus the telemetry planes (counter deltas, occupancy scans) — the
+    ledger samples are bit-identical either way, this path just skips
+    work the caller will not read. obs/txntrace.capture runs on this;
+    returns ``(state, ledger)``.
+    """
+    carry0, ro, blanks = _ro_outside(state)
+
+    def body(s, _):
+        out, led = cycle(cfg, s.replace(**ro), with_ledger=True,
+                         message_phase=message_phase)
+        return out.replace(**blanks), led
+
+    final, ledger = jax.lax.scan(body, carry0, None, length=num_cycles)
+    return final.replace(**ro), ledger
 
 
 @functools.partial(jax.jit, static_argnums=(0, 2))

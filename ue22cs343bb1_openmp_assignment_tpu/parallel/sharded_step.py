@@ -50,6 +50,36 @@ def make_sharded_runner(cfg: SystemConfig, mesh, example_state,
     return run
 
 
+def make_sharded_ledger_runner(cfg: SystemConfig, mesh, example_state,
+                               num_cycles: int):
+    """jit a `num_cycles`-cycle scan that also stacks the per-cycle
+    message ledger (ops.step cycle with_ledger) — the multi-chip twin
+    of ``run_cycles_telemetry(..., with_ledger=True)`` minus the
+    telemetry planes. Every ledger plane is node-major ([T, N] or
+    [T, N, S]), so GSPMD partitions the capture along the same node
+    axis as the state and the stacked output gathers back bit-identical
+    to the unsharded run (tests/test_txntrace.py pins this: the
+    arbitration sort is a total order, so sharding cannot reorder
+    deliveries).
+    """
+    from ue22cs343bb1_openmp_assignment_tpu.ops.step import _ro_outside
+    sh = state_shardings(cfg, mesh, example_state)
+
+    @functools.partial(jax.jit, in_shardings=(sh,))
+    def run(state):
+        carry0, ro, blanks = _ro_outside(state)
+
+        def body(s, _):
+            out, led = cycle(cfg, s.replace(**ro), with_ledger=True)
+            return out.replace(**blanks), led
+
+        final, ledger = jax.lax.scan(body, carry0, None,
+                                     length=num_cycles)
+        return final.replace(**ro), ledger
+
+    return run
+
+
 def make_sharded_round(cfg: SystemConfig, mesh, example_state):
     """jit one transactional-engine round (ops.sync_engine) with
     node-axis shardings: caches/traces partition by node, the flat
