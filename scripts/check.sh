@@ -17,6 +17,13 @@
 # wall-clock enforced by timeout(1); diverging traces are ddmin-shrunk
 # in the same invocation.
 #
+# The table smoke runs the declarative-protocol-table prong: the four
+# static verify passes (totality, determinism, ownership conservation,
+# stability + anchor provenance) over the MESI/MOESI/MESIF tables, then
+# the table-vs-handlers conformance gate — an exhaustive differential
+# over the 2n2h scope comparing full post-states bit-for-bit. Also
+# ≤30 s boxed; exit 1 on any finding or first divergence.
+#
 # The obs smoke step runs `cache-sim stats` on the mini fixture and
 # validates the emitted report against the cache-sim/metrics/v1.1
 # schema (the golden comparison lives in tests/test_obs.py). The txn
@@ -39,6 +46,9 @@ python -m ue22cs343bb1_openmp_assignment_tpu.analysis --jaxpr ${ANALYZE_ARGS:-}
 
 timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.analysis \
     --skip-model-check --skip-lint --fuzz "${FUZZ_N:-16}" --seed 0
+
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.analysis \
+    --table --skip-model-check --skip-lint
 
 python -m ue22cs343bb1_openmp_assignment_tpu.cli stats mini \
     --tests-root tests/fixtures --out /tmp/_obs_smoke.json

@@ -1,0 +1,258 @@
+"""The declarative protocol table and its verification stack.
+
+Four layers, mirroring the subsystem (analysis/protocol_table.py,
+verify_table.py, conformance.py):
+
+* table verification — the four static passes are clean on all three
+  shipped tables, and each seeded TABLE mutant trips exactly its
+  expected finding (the passes' own regression suite).
+* conformance gate — the MESI table is bit-equivalent to the live
+  handlers over full small scopes (fast: 2n2h; slow: the
+  symmetry-reduced 4-node scope and the 3-node eviction scope), and
+  every seeded HANDLER mutant diverges from the table (fast: two
+  representative mutants; slow: all six).
+* protocol variants — MOESI and MESIF table-compiled phases run clean
+  through the unmodified model checker, with engaged-pair evidence
+  that OWNED/FORWARD states were actually reached.
+* plumbing — cfg.protocol validation, the protocol-aware state-range
+  invariant, and the `analyze --table` CLI exit codes.
+"""
+
+import dataclasses
+
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# verify_table: static passes
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", ["mesi", "moesi", "mesif"])
+def test_verify_passes_clean(name):
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import (protocol_table,
+                                                             verify_table)
+    rep = verify_table.verify(protocol_table.TABLES[name]())
+    assert rep["ok"], rep["findings"]
+    assert rep["rows"] == 30
+    assert set(rep["passes"]) == {"totality_determinism", "conservation",
+                                  "stability", "anchors"}
+    assert all(v == "ok" for v in rep["passes"].values())
+
+
+def test_anchors_cover_the_registry_bidirectionally():
+    """Every row cites a registered reference anchor AND every
+    registered anchor/quirk is cited by some row — the table can
+    neither invent provenance nor silently drop a documented
+    transition."""
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import (protocol_table,
+                                                             verify_table)
+    from ue22cs343bb1_openmp_assignment_tpu.ops import handlers
+    table = protocol_table.mesi_table()
+    registered = {a for anchors in handlers.TRANSITION_ANCHORS.values()
+                  for a in anchors}
+    cited = {r.anchor for r in table.rows}
+    assert cited == registered
+    assert {q for r in table.rows for q in r.quirks} == set(handlers.QUIRKS)
+
+
+@pytest.mark.parametrize("mutation", ["table_guard_overlap",
+                                      "table_drop_row"])
+def test_table_mutant_is_caught(mutation):
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import (mutations,
+                                                             protocol_table,
+                                                             verify_table)
+    fn, expected = mutations.TABLE_MUTATIONS[mutation]
+    rep = verify_table.verify(fn(protocol_table.mesi_table()))
+    assert not rep["ok"], f"{mutation} survived verify_table"
+    assert expected in {f["kind"] for f in rep["findings"]}, (
+        mutation, expected, rep["findings"])
+
+
+def test_conservation_catches_missing_assumes():
+    """The FLUSH_INVACK home rows are conservation-safe only under
+    their declared dir-state precondition; stripping the `assumes`
+    must surface the latent quirk (a U-state delivery would resurrect
+    a sharer bit)."""
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import (protocol_table,
+                                                             verify_table)
+    from ue22cs343bb1_openmp_assignment_tpu.analysis.protocol_table import \
+        Guard
+    table = protocol_table.mesi_table()
+    rows = tuple(
+        dataclasses.replace(r, assumes=Guard())
+        if r.name.startswith("fia_") and r.guard.at_home else r
+        for r in table.rows)
+    rep = verify_table.verify(dataclasses.replace(table, rows=rows))
+    assert "conservation_violation" in {f["kind"] for f in rep["findings"]}
+
+
+# ---------------------------------------------------------------------------
+# conformance: table == handlers, by exhaustion
+# ---------------------------------------------------------------------------
+
+def _conform(scope_name, **kw):
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import (conformance,
+                                                             protocol_table)
+    scope = conformance.conformance_scopes()[scope_name]
+    return conformance.check_conformance(scope, protocol_table.mesi_table(),
+                                         **kw)
+
+
+def test_conformance_2n2h_bit_exact():
+    rep = _conform("2n2h")
+    assert rep["ok"], rep["findings"]
+    assert rep["stats"]["states"] == 60
+    assert rep["stats"]["msg_events"] > 0
+    # the dynamic audit matched exactly one row at every message event
+    assert not [f for f in rep["findings"] if f["check"] == "row_match"]
+
+
+@pytest.mark.slow
+def test_conformance_4n1a_sym_bit_exact():
+    """The symmetry-reduced 4-node scope: orbit representatives only,
+    but every explored transition is still checked both ways."""
+    rep = _conform("4n1a_sym")
+    assert rep["ok"], rep["findings"]
+    assert rep["stats"]["symmetry_group_order"] == 6
+
+
+@pytest.mark.slow
+def test_conformance_3n2a_ev_covers_eviction_rows():
+    """The conformance-only scope exists to light up the EVICT_SHARED
+    bookkeeping classes and UPGRADE; only the two structurally
+    unreachable bystander totality-completions may stay dark."""
+    rep = _conform("3n2a_ev")
+    assert rep["ok"], rep["findings"]
+    for row in ("es_home_last", "es_home_promote_self",
+                "es_home_promote_other", "es_home_many",
+                "es_remote_promote", "upgrade_grant", "inv_miss_noop"):
+        assert row in rep["row_coverage"], row
+
+
+@pytest.mark.slow
+def test_union_row_coverage_reaches_every_reachable_row():
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import (conformance,
+                                                             protocol_table)
+    covered = set()
+    for name in conformance.conformance_scopes():
+        rep = _conform(name)
+        assert rep["ok"], (name, rep["findings"])
+        covered |= set(rep["row_coverage"])
+    dark = {r.name
+            for r in protocol_table.mesi_table().rows} - covered
+    assert dark == {"flush_bystander", "fia_bystander"}, dark
+
+
+_FAST_MUTANTS = ["skip_em_bitvec_clear", "no_wait_clear_on_reply_rd"]
+
+
+def _assert_mutant_diverges(mutation):
+    from ue22cs343bb1_openmp_assignment_tpu.analysis.mutations import \
+        MUTATIONS
+    fn, scope_name, _ = MUTATIONS[mutation]
+    rep = _conform(scope_name, message_phase=fn)
+    assert not rep["ok"], f"{mutation} conforms to the MESI table"
+    div = [f for f in rep["findings"] if f["check"] == "divergence"]
+    assert div and div[0]["fields"], mutation
+    assert div[0]["ref_render"] != div[0]["table_render"]
+
+
+@pytest.mark.parametrize("mutation", _FAST_MUTANTS)
+def test_handler_mutant_diverges_from_table(mutation):
+    """The gate's own mutation test: a perturbed handler phase cannot
+    stay bit-equal to the table. Two representatives in the fast tier
+    (directory-side and wait-flag-side)."""
+    _assert_mutant_diverges(mutation)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mutation", [
+    "upgrade_keeps_other_sharers", "drop_evict_modified",
+    "stale_owner_forward", "evict_shared_keeps_bit"])
+def test_handler_mutant_diverges_from_table_full(mutation):
+    _assert_mutant_diverges(mutation)
+
+
+# ---------------------------------------------------------------------------
+# protocol variants: MOESI / MESIF through the unchanged model checker
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("protocol,state_name", [("moesi", "OWNED"),
+                                                 ("mesif", "FORWARD")])
+def test_variant_table_model_checks_clean(protocol, state_name):
+    """The variant table phase, run through the unmodified engine and
+    checker, verifies clean on a write/evict scope — and the engaged-
+    pair coverage proves the protocol's extra state was actually
+    reached, not just defined."""
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import (conformance,
+                                                             model_check,
+                                                             protocol_table)
+    from ue22cs343bb1_openmp_assignment_tpu.analysis.protocol_table import \
+        table_message_phase
+    scope = conformance.variant_scope(
+        model_check.builtin_scopes()["2n2a"], protocol)
+    rep = model_check.check_scope(
+        scope,
+        message_phase=table_message_phase(
+            protocol_table.TABLES[protocol]()))
+    assert rep["ok"], rep["violations"]
+    assert rep["stats"]["deadlocked_states"] == 0
+    assert any(state_name in p for p in rep["coverage"]["engaged_pairs"]), (
+        protocol, rep["coverage"]["engaged_pairs"])
+
+
+# ---------------------------------------------------------------------------
+# plumbing: cfg.protocol, protocol-aware invariants, CLI
+# ---------------------------------------------------------------------------
+
+def test_cfg_protocol_validation_and_allowed_states():
+    from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+    from ue22cs343bb1_openmp_assignment_tpu.types import CacheState
+    assert SystemConfig().protocol == "mesi"
+    with pytest.raises(ValueError):
+        SystemConfig(protocol="dragon")
+    base = {CacheState.MODIFIED, CacheState.EXCLUSIVE, CacheState.SHARED,
+            CacheState.INVALID}
+    assert set(SystemConfig().allowed_cache_states) == base
+    assert set(SystemConfig(protocol="moesi").allowed_cache_states) == (
+        base | {CacheState.OWNED})
+    assert set(SystemConfig(protocol="mesif").allowed_cache_states) == (
+        base | {CacheState.FORWARD})
+
+
+def test_state_range_invariant_is_protocol_aware():
+    """An OWNED line is in-range under a MOESI config but an
+    out-of-range violation under plain MESI — the invariant follows
+    cfg.protocol, so a MESI run writing 4 still gets flagged."""
+    import jax.numpy as jnp
+
+    from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+    from ue22cs343bb1_openmp_assignment_tpu.ops import invariants
+    from ue22cs343bb1_openmp_assignment_tpu.state import init_state
+    from ue22cs343bb1_openmp_assignment_tpu.types import CacheState
+
+    for protocol, bad in (("mesi", 1), ("moesi", 0)):
+        cfg = SystemConfig(num_nodes=2, protocol=protocol)
+        st = init_state(cfg)
+        st = st.replace(cache_state=st.cache_state.at[0, 0].set(
+            int(CacheState.OWNED)))
+        v = invariants.step_violations(cfg, st)
+        assert int(v["cache_state_out_of_range"]) == bad, protocol
+        assert int(jnp.asarray(
+            invariants.step_violations(cfg, init_state(cfg))
+            ["cache_state_out_of_range"])) == 0
+
+
+def test_analyze_table_cli_exit_codes():
+    """`analyze --table` joins the CI gate: 0 clean, 1 under either a
+    seeded table mutant (verify-table finding) or a seeded handler
+    mutant (conformance divergence). In-process to stay fast."""
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import runner
+    common = ["--table", "--skip-model-check", "--skip-lint", "-q"]
+    assert runner.main(common + ["--scopes", "2n1a"]) == 0
+    assert runner.main(common + ["--mutation", "table_drop_row"]) == 1
+    assert runner.main(common + ["--mutation",
+                                 "skip_em_bitvec_clear"]) == 1
+    # a table mutation aimed at the model-check prong is a usage error
+    with pytest.raises(SystemExit):
+        runner.main(["--skip-lint", "-q", "--mutation", "table_drop_row"])

@@ -1,0 +1,275 @@
+"""Table⇄handler conformance: prove the table equals the code.
+
+The declarative table (:mod:`.protocol_table`) claims to *be* the
+protocol that :func:`..ops.handlers.message_phase` implements. This
+module closes the loop with an exhaustive small-scope differential in
+the Ip & Dill style already powering the model checker: explore a
+scope's full (symmetry-reduced) state space, and at **every** reachable
+transition run the staged concrete state through *both* the live JAX
+handler phase and the table-compiled phase inside the unmodified
+``step.cycle`` engine, comparing the complete post-``SimState`` pytrees
+— caches, memory, directory, mailbox rings, metrics, everything — for
+bit equality. First divergence fails the gate with a replayable
+counterexample (event path from the initial state + the differing
+leaves + both state renders).
+
+Because the engine merge semantics make unmasked update lanes and
+unaccepted candidate slots unobservable (ops/step.py, ops/mailbox.py),
+full-post-state equality over the whole reachable space is exactly
+"the table and the handlers are the same protocol on this scope" — a
+proof by exhaustion, not an assertion. Scope exhaustiveness is the
+checker's: 2n2h is a complete 2-node enumeration, 4n1a_sym a
+symmetry-reduced 4-node one (S3 orbit dedup; witnesses un-permuted).
+
+The same sweep doubles as the table's *dynamic* audit: each message
+event is matched against the table host-side (:func:`
+.protocol_table.match_rows`) to record per-row firing coverage, verify
+exactly one row matches every reachable receiver valuation (totality/
+determinism on *reachable* points, complementing verify_table's full
+product), and check each fired row's ``assumes`` precondition — an
+``assumes`` that a reachable state falsifies is a finding, which is
+how the FLUSH_INVACK dir-state assumption stays honest.
+
+Swapping ``message_phase`` for a seeded mutant from
+:mod:`.mutations` turns the gate into a mutation test of itself: every
+handler mutant must diverge from the MESI table (tests/
+test_protocol_table.py).
+
+:class:`ConformanceChecker` subclasses :class:`.model_check.
+ModelChecker` for its staging, symmetry, and read-back machinery; the
+parent's single-phase oracle is never invoked (``jax.jit`` is lazy, so
+it is never compiled either).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ue22cs343bb1_openmp_assignment_tpu.analysis import model_check
+from ue22cs343bb1_openmp_assignment_tpu.analysis.model_check import (
+    _BATCH, ModelChecker, Scope, ScopeTooLarge, enabled_events)
+from ue22cs343bb1_openmp_assignment_tpu.analysis.protocol_table import (
+    ProtocolTable, guard_holds, host_atoms, match_rows, table_message_phase)
+from ue22cs343bb1_openmp_assignment_tpu.ops import handlers, step
+
+
+class ConformanceChecker(ModelChecker):
+    """Differential BFS: reference phase vs table-compiled phase."""
+
+    def __init__(self, scope: Scope, table: ProtocolTable,
+                 message_phase=None, max_states: int = 50_000):
+        super().__init__(scope, message_phase=message_phase,
+                         max_states=max_states)
+        self.table = table
+        ref_mp = message_phase if message_phase is not None \
+            else handlers.message_phase
+        tab_mp = table_message_phase(table)
+        cfg = self.cfg
+
+        def both(state):
+            return (step.cycle(cfg, state, message_phase=ref_mp),
+                    step.cycle(cfg, state, message_phase=tab_mp))
+
+        self._pair_oracle = jax.jit(jax.vmap(both))
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _leaf_paths(tree):
+        leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+        return leaves
+
+    def _mismatch_rows(self, ref, tab, n: int):
+        """Per-batch-row any-leaf-differs mask + per-row differing leaf
+        names (full SimState compare — bit equality or bust)."""
+        bad = np.zeros(n, bool)
+        names: list = [[] for _ in range(n)]
+        for (path, la), (_, lb) in zip(self._leaf_paths(ref),
+                                       self._leaf_paths(tab)):
+            la, lb = np.asarray(la), np.asarray(lb)
+            neq = (la != lb).reshape(la.shape[0], -1).any(axis=1)[:n]
+            if neq.any():
+                label = jax.tree_util.keystr(path)
+                for j in np.flatnonzero(neq):
+                    names[j].append(label)
+                bad |= neq
+        return bad, names
+
+    def _audit_rows(self, a, actor: int, findings: list, coverage: dict,
+                    sid: int, parent, states) -> None:
+        """Host-side row matching for one message event: coverage +
+        reachable-point totality/determinism + `assumes` validation."""
+        atoms = host_atoms(self.cfg, a, actor, a.queues[actor][0])
+        rows = match_rows(self.table, atoms)
+        if len(rows) != 1:
+            findings.append(dict(
+                check="row_match", state=sid,
+                detail=f"{len(rows)} table rows match a reachable "
+                       f"receiver valuation {atoms} "
+                       f"(rows: {[r.name for r in rows]})",
+                path=self.path_to(parent, states, sid)))
+            return
+        row = rows[0]
+        coverage[row.name] = coverage.get(row.name, 0) + 1
+        if not guard_holds(row.assumes, atoms):
+            findings.append(dict(
+                check="assumes_violation", state=sid, row=row.name,
+                detail=f"row {row.name} fired on a reachable state that "
+                       f"falsifies its assumes precondition ({atoms})",
+                path=self.path_to(parent, states, sid)))
+
+    # -- the differential run ---------------------------------------------
+
+    def run(self) -> dict:
+        scope = self.scope
+        a0 = self._a0
+        ids = {a0: 0}
+        states = [a0]
+        parent = [None]
+        findings: list = []
+        coverage: dict = {}
+        n_msg = n_instr = 0
+
+        frontier = [0]
+        diverged = False
+        while frontier and not diverged:
+            jobs = []
+            for sid in frontier:
+                jobs.extend((sid, ev)
+                            for ev in enabled_events(scope, states[sid]))
+            nxt = []
+            for start in range(0, len(jobs), _BATCH):
+                if diverged:
+                    break
+                chunk = jobs[start:start + _BATCH]
+                batch = self._batched(
+                    [self._stage(states[sid], ev) for sid, ev in chunk])
+                res_ref, res_tab = jax.device_get(self._pair_oracle(batch))
+                bad, leaf_names = self._mismatch_rows(
+                    res_ref, res_tab, len(chunk))
+                for j, (sid, ev) in enumerate(chunk):
+                    if ev[0] == "msg":
+                        n_msg += 1
+                        self._audit_rows(states[sid], ev[1], findings,
+                                         coverage, sid, parent, states)
+                    else:
+                        n_instr += 1
+                    if bad[j]:
+                        # first diverging transition: full counterexample
+                        pa, _, _ = self._read_back(states[sid], ev,
+                                                   res_ref, j)
+                        pb, _, _ = self._read_back(states[sid], ev,
+                                                   res_tab, j)
+                        findings.append(dict(
+                            check="divergence", state=sid,
+                            event=self._render_event(states[sid], ev),
+                            fields=leaf_names[j],
+                            detail=f"handlers and table disagree after "
+                                   f"{self._render_event(states[sid], ev)}"
+                                   f" (leaves: {leaf_names[j]})",
+                            path=self.path_to(parent, states, sid),
+                            ref_render=self.render_state(pa),
+                            table_render=self.render_state(pb)))
+                        diverged = True
+                        break
+                    new_a, _, _ = self._read_back(states[sid], ev,
+                                                  res_ref, j)
+                    new_a, gi = self._canon(new_a)
+                    nid = ids.get(new_a)
+                    if nid is None:
+                        nid = len(states)
+                        ids[new_a] = nid
+                        states.append(new_a)
+                        parent.append((sid, ev, gi))
+                        nxt.append(nid)
+                        if nid >= self.max_states:
+                            raise ScopeTooLarge(
+                                f"scope {scope.name}: > {self.max_states} "
+                                "states")
+            frontier = nxt
+
+        uncovered = sorted(r.name for r in self.table.rows
+                           if r.name not in coverage)
+        return dict(
+            scope=scope.describe(),
+            table=self.table.name,
+            protocol=self.table.protocol,
+            stats=dict(
+                states=len(states),
+                transitions=n_msg + n_instr,
+                msg_events=n_msg,
+                instr_events=n_instr,
+                symmetry_group_order=len(self._group),
+                rows_covered=len(coverage),
+                rows_total=len(self.table.rows),
+            ),
+            row_coverage=dict(sorted(coverage.items())),
+            uncovered_rows=uncovered,
+            findings=findings,
+            ok=not findings,
+        )
+
+
+def check_conformance(scope: Scope, table: ProtocolTable,
+                      message_phase=None, max_states: int = 50_000) -> dict:
+    """One-call convenience mirroring model_check.check_scope."""
+    return ConformanceChecker(scope, table, message_phase=message_phase,
+                              max_states=max_states).run()
+
+
+def variant_scope(scope: Scope, protocol: str) -> Scope:
+    """The same scope with cfg.protocol swapped — for model-checking the
+    MOESI/MESIF table phases through the unchanged engine."""
+    import dataclasses
+    return Scope(name=f"{scope.name}_{protocol}",
+                 cfg=dataclasses.replace(scope.cfg, protocol=protocol),
+                 programs=scope.programs,
+                 mem_uniform=scope.mem_uniform)
+
+
+def extra_scopes() -> dict:
+    """Conformance-only scopes, beyond :func:`.model_check.
+    builtin_scopes`.
+
+    ``3n2a_ev`` — 3 nodes, two addresses conflicting on one
+    direct-mapped line, a reader-evictor racing a reader-upgrader:
+    drives every EVICT_SHARED home bookkeeping class (last sharer /
+    self-promotion / notify-other / 2+ left), the UPGRADE S-write-hit
+    grant, and the sanctioned INV tag-miss no-op — the rows the
+    builtin scopes leave dark. 1267 states, exhaustive (trivial
+    symmetry group: the three programs are distinct). Kept out of the
+    builtin registry so the default ``analyze`` model-check wall-clock
+    is unchanged; the union of builtin + extra scope coverage reaches
+    every MESI row except the two bystander totality-completions
+    (FLUSH/FLUSH_INVACK are only ever routed to home or second, so a
+    true bystander delivery cannot occur — the rows exist to close
+    the (at_home, at_second) guard product).
+    """
+    from ue22cs343bb1_openmp_assignment_tpu import codec
+    from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+    from ue22cs343bb1_openmp_assignment_tpu.types import Op
+    cfg3 = SystemConfig(num_nodes=3, cache_size=1, mem_size=2,
+                        queue_capacity=16, max_instrs=4,
+                        inv_mode="mailbox")
+    a = codec.make_address(cfg3, 0, 0)
+    b = codec.make_address(cfg3, 0, 1)
+    R, W = int(Op.READ), int(Op.WRITE)
+    sc = Scope("3n2a_ev", cfg3, (
+        ((R, a, 0),),
+        ((R, a, 0), (R, b, 0)),
+        ((R, a, 0), (W, a, 6)),
+    ))
+    return {sc.name: sc}
+
+
+def conformance_scopes() -> dict:
+    """Everything the gate can run over: builtin + conformance-only."""
+    scopes = dict(model_check.builtin_scopes())
+    scopes.update(extra_scopes())
+    return scopes
+
+
+# referenced for the side effect of keeping the import explicit: the
+# checker's scope registry is the conformance gate's scope registry
+builtin_scopes = model_check.builtin_scopes

@@ -11,6 +11,13 @@ Every wrapper keeps the `message_phase` contract (updates, cand_parts,
 inv_scatter, stats) and is injected through ops/step.cycle's
 ``message_phase`` hook, so the surrounding engine — merge, delivery,
 arbitration — stays the shipped code.
+
+:data:`TABLE_MUTATIONS` is the same idea one level up: seeded bugs in
+the declarative protocol table, each caught *statically* by
+analysis/verify_table.py without running a single cycle — and the
+handler mutants above double as conformance-gate mutants, since any of
+them makes the live phase diverge from the MESI table
+(analysis/conformance.py, tests/test_protocol_table.py).
 """
 
 from __future__ import annotations
@@ -137,6 +144,46 @@ def evict_shared_keeps_bit(cfg, state, mv):
     upd = dict(upd, dir_bv=(
         m, i, jnp.where(es_home[:, None], dirbv, v)))
     return upd, cand, inv, stats
+
+
+# ---------------------------------------------------------------------------
+# Table-level mutants: seeded bugs in the DECLARATIVE protocol table
+# (analysis/protocol_table.py), caught statically by verify_table with
+# no simulation at all — the verify passes' own regression suite,
+# mirroring what MUTATIONS is for the model checker. Each takes a
+# ProtocolTable and returns a perturbed copy.
+# ---------------------------------------------------------------------------
+
+def table_guard_overlap(table):
+    """Widen ``es_home_last``'s guard to ALL of EVICT_SHARED@home (drop
+    the others=0 key): it now overlaps every other es_home_* row — the
+    classic copy-paste-a-row-and-forget-the-key bug. Expected:
+    `determinism_overlap` from the totality/determinism pass."""
+    import dataclasses
+    from ue22cs343bb1_openmp_assignment_tpu.analysis.protocol_table import \
+        Guard
+    rows = tuple(
+        dataclasses.replace(r, guard=Guard(at_home=True))
+        if r.name == "es_home_last" else r for r in table.rows)
+    return dataclasses.replace(table, name=table.name + "+guard_overlap",
+                               rows=rows)
+
+
+def table_drop_row(table):
+    """Delete the EVICT_MODIFIED row outright — a dirty eviction
+    arrives and no rule fires, the message-vocabulary analogue of
+    `drop_evict_modified`. Expected: `totality_hole`."""
+    import dataclasses
+    rows = tuple(r for r in table.rows if r.name != "evict_modified")
+    return dataclasses.replace(table, name=table.name + "+drop_row",
+                               rows=rows)
+
+
+# name -> (mutator, verify_table finding kind it must trigger)
+TABLE_MUTATIONS = {
+    "table_guard_overlap": (table_guard_overlap, "determinism_overlap"),
+    "table_drop_row": (table_drop_row, "totality_hole"),
+}
 
 
 # name -> (wrapper, scope that exposes it, finding the checker must raise)

@@ -19,14 +19,22 @@ import json
 import sys
 
 _EPILOG = """\
-exit codes:
+exit codes — the one canonical contract for `cache-sim analyze`:
   0  clean pass — every requested check ran to completion and passed
   1  findings — a protocol violation, lint finding, fuzz divergence,
+     table-verification failure, table/handler conformance divergence,
      or failed recompilation guard
+  2  usage error (argparse's code, left untouched)
   3  budget exhausted, no finding — a scope hit --max-states before
      exhausting its state space: nothing failed, but nothing was
      proven either; raise --max-states or shrink the scope
-(2 is argparse's usage-error code, left untouched)"""
+findings always win: a run that both finds a violation and exhausts a
+budget exits 1, not 3.
+
+related gate (documented here because the two share scripts/check.sh):
+`cache-sim bench-diff` exits 0 = no regression (difference is noise),
+2 = incomparable (configs/sample sizes don't support a verdict),
+4 = statistically significant regression."""
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -41,13 +49,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated scope names (default: all "
                         "builtin scopes)")
     p.add_argument("--list-scopes", action="store_true",
-                   help="print the builtin scopes and exit")
+                   help="print every scope (builtin + conformance-only) "
+                        "with dimensions and programs, then exit")
     p.add_argument("--skip-model-check", action="store_true")
     p.add_argument("--skip-lint", action="store_true")
+    p.add_argument("--table", action="store_true",
+                   help="run the declarative-protocol-table prong: "
+                        "verify_table static passes (totality, "
+                        "determinism, conservation, stability, anchors) "
+                        "over the MESI/MOESI/MESIF tables, then the "
+                        "table-vs-handlers conformance gate on --scopes "
+                        "(default 2n2h)")
     p.add_argument("--mutation", default=None,
-                   help="run the checker/fuzzer with this seeded handler "
-                        "bug from analysis.mutations (the gate must "
-                        "fail — its own regression test)")
+                   help="run the gates with this seeded bug: a handler "
+                        "mutation from analysis.mutations.MUTATIONS "
+                        "(checker/fuzzer/conformance must fail) or a "
+                        "table mutation from TABLE_MUTATIONS "
+                        "(verify-table must fail) — the gates' own "
+                        "regression test")
     p.add_argument("--max-states", type=int, default=50_000,
                    help="state-count guard per scope (default 50000); "
                         "exceeding it without a finding exits 3")
@@ -89,9 +108,17 @@ def _resolve_mutation(name):
     if name is None:
         return None, None, None
     from ue22cs343bb1_openmp_assignment_tpu.analysis import mutations
+    if name in mutations.TABLE_MUTATIONS:
+        raise SystemExit(
+            f"`{name}` is a table mutation — it seeds a bug in the "
+            "declarative table, not the handlers, so it only applies to "
+            "the --table prong (run with --table --skip-model-check "
+            "--skip-lint)")
     if name not in mutations.MUTATIONS:
-        raise SystemExit(f"unknown mutation `{name}` "
-                         f"(have: {', '.join(mutations.MUTATIONS)})")
+        raise SystemExit(
+            f"unknown mutation `{name}` (handler mutations: "
+            f"{', '.join(mutations.MUTATIONS)}; table mutations: "
+            f"{', '.join(mutations.TABLE_MUTATIONS)})")
     return mutations.MUTATIONS[name]
 
 
@@ -183,6 +210,89 @@ def run_jaxpr(quiet) -> dict:
     return rep
 
 
+def run_table(scope_names, mutation, max_states, quiet) -> dict:
+    """The declarative-table prong: static verify passes over all three
+    protocol tables, then the table-vs-handlers conformance gate."""
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import (conformance,
+                                                             mutations,
+                                                             protocol_table,
+                                                             verify_table)
+    out = {"verify": {}, "conformance": {}}
+    tmut = mutations.TABLE_MUTATIONS.get(mutation) if mutation else None
+    hmut = mutations.MUTATIONS.get(mutation) if mutation else None
+    if mutation and tmut is None and hmut is None:
+        raise SystemExit(
+            f"unknown mutation `{mutation}` (handler mutations: "
+            f"{', '.join(mutations.MUTATIONS)}; table mutations: "
+            f"{', '.join(mutations.TABLE_MUTATIONS)})")
+
+    for name, factory in protocol_table.TABLES.items():
+        tbl = factory()
+        if tmut is not None and name == "mesi":
+            tbl = tmut[0](tbl)
+            _print(quiet, f"== seeded table mutation `{mutation}` "
+                          f"(expected finding: {tmut[1]})")
+        rep = verify_table.verify(tbl)
+        out["verify"][name] = rep
+        passes = " ".join(f"{p}={'ok' if v == 'ok' else 'FAIL'}"
+                          for p, v in rep["passes"].items())
+        _print(quiet, f"== table {tbl.name}: "
+                      f"{'ok' if rep['ok'] else 'FAIL'} "
+                      f"[{rep['rows']} rows; {passes}]")
+        for f in rep["findings"][:8]:
+            _print(quiet, f"  ! {f['kind']}: {f['detail']}")
+
+    if tmut is not None:
+        # a mutated table is (intentionally) not the handlers' protocol;
+        # conformance against the live phase would only restate the
+        # verify findings, so the prong stops at the static passes
+        return out
+
+    scopes = conformance.conformance_scopes()
+    if scope_names is not None:
+        names = [s.strip() for s in scope_names.split(",") if s.strip()]
+        unknown = [n for n in names if n not in scopes]
+        if unknown:
+            raise SystemExit(f"unknown scope(s): {', '.join(unknown)} "
+                             f"(have: {', '.join(scopes)})")
+    elif hmut is not None:
+        names = [hmut[1]]   # the scope documented to expose the mutant
+        _print(quiet, f"== seeded handler mutation `{mutation}` on scope "
+                      f"{hmut[1]} (conformance vs the MESI table must "
+                      "diverge)")
+    else:
+        names = ["2n2h"]
+    mp = hmut[0] if hmut is not None else None
+    tbl = protocol_table.mesi_table()
+    for name in names:
+        try:
+            rep = conformance.check_conformance(
+                scopes[name], tbl, message_phase=mp, max_states=max_states)
+        except conformance.ScopeTooLarge as e:
+            out["conformance"][name] = {"ok": None,
+                                        "budget_exhausted": True,
+                                        "detail": str(e)}
+            _print(quiet, f"== conformance {name}: BUDGET EXHAUSTED "
+                          f"({e}) — no finding; not a pass")
+            continue
+        out["conformance"][name] = rep
+        st = rep["stats"]
+        _print(quiet,
+               f"== conformance {name}: {'ok' if rep['ok'] else 'FAIL'} "
+               f"[{st['states']} states, {st['msg_events']} msg events, "
+               f"rows {st['rows_covered']}/{st['rows_total']}, "
+               f"sym x{st['symmetry_group_order']}]")
+        for f in rep["findings"][:4]:
+            _print(quiet, f"  ! {f['check']}: {f['detail']}")
+            for step in f.get("path", [])[-6:]:
+                _print(quiet, f"      > {step}")
+            for line in f.get("ref_render", []):
+                _print(quiet, f"      |ref   {line}")
+            for line in f.get("table_render", []):
+                _print(quiet, f"      |table {line}")
+    return out
+
+
 def run_fuzz(n_cases, seed, mutation, repro_dir, quiet,
              flight_dir=None) -> dict:
     from ue22cs343bb1_openmp_assignment_tpu.analysis import fuzz as fz
@@ -216,20 +326,36 @@ def run_fuzz(n_cases, seed, mutation, repro_dir, quiet,
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_scopes:
-        from ue22cs343bb1_openmp_assignment_tpu.analysis import model_check
-        for name, scope in model_check.builtin_scopes().items():
+        from ue22cs343bb1_openmp_assignment_tpu.analysis import (conformance,
+                                                                 model_check)
+        builtin = set(model_check.builtin_scopes())
+        for name, scope in conformance.conformance_scopes().items():
             d = scope.describe()
-            print(f"{name}: {d['num_nodes']} nodes, programs "
-                  f"{d['programs']}")
+            tag = "" if name in builtin else "  [conformance-only]"
+            print(f"{name}: {d['num_nodes']} nodes, cache {d['cache_size']}"
+                  f", mem {d['mem_size']} ({d['mem_init']}){tag}")
+            for i, prog in enumerate(d["programs"]):
+                print(f"    node {i}: "
+                      + "; ".join(f"{op} a={a} v={v}" for op, a, v in prog))
         return 0
 
     report = {"model_check": {}, "lint": None, "jaxpr": None,
-              "fuzz": None}
+              "fuzz": None, "table": None}
     ok, exhausted = True, False
     if not args.skip_model_check:
         report["model_check"] = run_model_check(
             args.scopes, args.mutation, args.max_states, args.quiet)
         for r in report["model_check"].values():
+            if r.get("budget_exhausted"):
+                exhausted = True
+            else:
+                ok &= r["ok"]
+    if args.table:
+        report["table"] = run_table(args.scopes, args.mutation,
+                                    args.max_states, args.quiet)
+        for r in report["table"]["verify"].values():
+            ok &= r["ok"]
+        for r in report["table"]["conformance"].values():
             if r.get("budget_exhausted"):
                 exhausted = True
             else:

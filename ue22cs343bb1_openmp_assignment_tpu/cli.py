@@ -37,11 +37,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--out-dir", default=".",
                    help="where to write core_<n>_output.txt dumps")
     p.add_argument("--workload", choices=["uniform", "producer_consumer",
-                                          "false_sharing", "fft", "radix",
+                                          "false_sharing",
+                                          "false_sharing_vars",
+                                          "false_sharing_vars_padded",
+                                          "fft", "radix",
                                           "hotspot", "lu"],
                    help="run a synthetic workload instead of trace files "
                         "(fft/radix are SPLASH-2-style reference "
-                        "patterns)")
+                        "patterns; false_sharing_vars[_padded] is the "
+                        "colliding-variables stress and its padding fix)")
     p.add_argument("--nodes", type=int, default=4)
     p.add_argument("--trace-len", type=int, default=32)
     p.add_argument("--queue-capacity", type=int, default=None,

@@ -149,6 +149,17 @@ class SystemConfig:
     # it on automatically when a TPU backend is attached.
     pallas_burst: bool = False
 
+    # Coherence protocol variant. 'mesi' is the reference protocol and
+    # the only one the hand-written ops/handlers.py implements; 'moesi'
+    # and 'mesif' are expressed as declarative tables
+    # (analysis/protocol_table.py) compiled to drop-in message phases.
+    # The engine itself is protocol-agnostic — this field's one runtime
+    # effect is widening the cache-state range invariant
+    # (ops/invariants.py) to admit the variant's extra state (OWNED /
+    # FORWARD, types.py), and it keys which table the analysis layer
+    # pairs with a scope.
+    protocol: str = "mesi"
+
     # Admission window (backpressure): maximum number of simultaneously
     # outstanding request transactions system-wide. The reference silently
     # drops on overflow (assignment.c:754-762), which at its dimensions is
@@ -188,12 +199,27 @@ class SystemConfig:
                 "int32 scatter-add (ke can reach num_nodes), and "
                 "multi-slot storm rows use requester id 0xFFFF as "
                 "the matches-nobody sentinel (ops/deep_engine)")
+        if self.protocol not in ("mesi", "moesi", "mesif"):
+            raise ValueError(f"bad protocol {self.protocol!r}")
         if self.inv_mode not in ("mailbox", "scatter"):
             raise ValueError(f"bad inv_mode {self.inv_mode!r}")
         if self.inv_mode == "mailbox" and self.num_nodes > 64:
             raise ValueError(
                 "inv_mode='mailbox' materializes num_nodes INV out-slots per "
                 "node per cycle; use inv_mode='scatter' above 64 nodes")
+
+    @property
+    def allowed_cache_states(self) -> tuple:
+        """Legal cache-line state values under cfg.protocol (plain ints,
+        so the range invariant can static-unroll over them)."""
+        from ue22cs343bb1_openmp_assignment_tpu.types import CacheState
+        base = (int(CacheState.MODIFIED), int(CacheState.EXCLUSIVE),
+                int(CacheState.SHARED), int(CacheState.INVALID))
+        if self.protocol == "moesi":
+            return base + (int(CacheState.OWNED),)
+        if self.protocol == "mesif":
+            return base + (int(CacheState.FORWARD),)
+        return base
 
     # -- address codec geometry -------------------------------------------
     @property

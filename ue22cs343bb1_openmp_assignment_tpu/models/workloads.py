@@ -9,6 +9,8 @@ RD/WR, producer-consumer, and false-sharing stress — directly as
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -70,6 +72,42 @@ def false_sharing(key, cfg: SystemConfig, trace_len: int,
     is_write = jax.random.uniform(k2, shape) < 0.5
     op = jnp.where(is_write, int(Op.WRITE), int(Op.READ)).astype(jnp.int32)
     val = jax.random.randint(k3, shape, 0, 256, dtype=jnp.int32)
+    return op, addr, val, jnp.full((N,), trace_len, jnp.int32)
+
+
+def false_sharing_vars(key, cfg: SystemConfig, trace_len: int,
+                       vars_per_block: int = 4, padded: bool = False,
+                       write_frac: float = 0.75):
+    """Per-node private variables that collide on a coherence unit.
+
+    The textbook false-sharing shape: node ``n``'s variable belongs to
+    group ``n // vars_per_block``, and every node in a group touches the
+    *same* block (``group % mem_size`` homed at ``group % N``) — the
+    variables are logically disjoint, but the block is the coherence
+    unit, so each node's write-mostly stream (``write_frac`` writes)
+    invalidates its groupmates anyway. ``padded=True`` is the classic
+    cache-line-padding fix: every node's variable moves to its own home
+    node's memory, so footprints are provably disjoint across nodes and
+    the coherence tier (ops/invariants.py) must be exactly zero — the
+    padded/unpadded pair is a before/after benchmark of the same
+    logical program.
+    """
+    N = cfg.num_nodes
+    k1, k2 = jax.random.split(key)
+    shape = (N, trace_len)
+    ids = jnp.arange(N, dtype=jnp.int32)[:, None]
+    if padded:
+        node = ids                       # own home: disjoint by node
+        block = ids % cfg.mem_size
+    else:
+        group = ids // vars_per_block    # groupmates share one block
+        node = group % N
+        block = group % cfg.mem_size
+    addr = codec.make_address(cfg, jnp.broadcast_to(node, shape),
+                              jnp.broadcast_to(block, shape))
+    is_write = jax.random.uniform(k1, shape) < write_frac
+    op = jnp.where(is_write, int(Op.WRITE), int(Op.READ)).astype(jnp.int32)
+    val = jax.random.randint(k2, shape, 0, 256, dtype=jnp.int32)
     return op, addr, val, jnp.full((N,), trace_len, jnp.int32)
 
 
@@ -228,6 +266,9 @@ GENERATORS = {
     "uniform": uniform_random,
     "producer_consumer": producer_consumer,
     "false_sharing": false_sharing,
+    "false_sharing_vars": false_sharing_vars,
+    "false_sharing_vars_padded": functools.partial(false_sharing_vars,
+                                                   padded=True),
     "fft": fft_transpose,
     "radix": radix_sort,
     "lu": lu_blocked,

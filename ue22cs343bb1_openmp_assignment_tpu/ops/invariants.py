@@ -40,6 +40,9 @@ so checks compose with `jit`/`scan` (no host sync until you ask).
 
 from __future__ import annotations
 
+import functools
+import operator
+
 import jax.numpy as jnp
 
 from ue22cs343bb1_openmp_assignment_tpu import codec
@@ -76,9 +79,15 @@ def step_predicates(cfg: SystemConfig, state: SimState) -> dict:
         # enum ranges (a scatter writing garbage shows up here first)
         "dir_state_out_of_range":
             (state.dir_state < 0) | (state.dir_state > int(DirState.U)),
+        # protocol-aware: MESI admits 0..3; the MOESI/MESIF table phases
+        # additionally emit OWNED/FORWARD (types.py). Static unroll over
+        # the (3-5 element) allowed tuple — cfg is jit-static, so this
+        # folds to a constant membership mask.
         "cache_state_out_of_range":
-            (state.cache_state < 0)
-            | (state.cache_state > int(CacheState.INVALID)),
+            ~functools.reduce(
+                operator.or_,
+                [state.cache_state == s
+                 for s in cfg.allowed_cache_states]),
         # ring occupancy within capacity, head within ring
         "mailbox_count_oob":
             (state.mb_count < 0) | (state.mb_count > cfg.queue_capacity),

@@ -23,6 +23,14 @@ class CacheState(enum.IntEnum):
     EXCLUSIVE = 1
     SHARED = 2
     INVALID = 3
+    # Variant-protocol states (analysis/protocol_table.py). Appended
+    # after INVALID so the reference values 0-3 — and every dump/golden
+    # that indexes by them — are untouched; INVALID stays the MESI
+    # fill/reset sentinel. Only table-driven MOESI/MESIF phases emit
+    # these; the range invariant (ops/invariants.py) admits them only
+    # when cfg.protocol does.
+    OWNED = 4     # MOESI: dirty but shared, owner responds instead of memory
+    FORWARD = 5   # MESIF: clean designated forwarder among sharers
 
 
 class DirState(enum.IntEnum):
@@ -52,7 +60,8 @@ class Msg(enum.IntEnum):
     NONE = 13
 
 
-CACHE_STATE_NAMES = ("MODIFIED", "EXCLUSIVE", "SHARED", "INVALID")
+CACHE_STATE_NAMES = ("MODIFIED", "EXCLUSIVE", "SHARED", "INVALID",
+                     "OWNED", "FORWARD")
 DIR_STATE_NAMES = ("EM", "S", "U")
 
 MSG_NAMES = tuple(m.name for m in Msg if m is not Msg.NONE)
