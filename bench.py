@@ -439,7 +439,40 @@ def main():
     print(json.dumps(extra), file=sys.stderr)
 
     if args.record:
-        from ue22cs343bb1_openmp_assignment_tpu.obs import history
+        from ue22cs343bb1_openmp_assignment_tpu.obs import (
+            history, roofline)
+        # the deterministic comparability keys (obs v4): device kind +
+        # compiled-HLO fingerprint let bench-diff refuse cross-device
+        # comparisons, and the cost vector feeds the exact --bytes gate
+        device_kind = roofline.detect_device_kind()
+        cost = hlo_fp = None
+        try:
+            if not (args.engine == "sync" and args.replicas > 1):
+                if sync_like:
+                    per_rec = roofline.kernel_record(
+                        "sync.round_step",
+                        jax.jit(lambda s: se.round_step(cfg, s)), st0)
+                    run_rec = roofline.kernel_record(
+                        f"sync.run_to_quiescence[chunk={args.chunk}]",
+                        se._run_sync_jit, cfg, st0, args.chunk,
+                        max_cycles)
+                else:
+                    from ue22cs343bb1_openmp_assignment_tpu.ops import (
+                        step as step_mod)
+                    per_rec = roofline.kernel_record(
+                        "step.cycle",
+                        jax.jit(lambda s: step_mod.cycle(cfg, s)), st0)
+                    run_rec = roofline.kernel_record(
+                        f"step.run_chunked[chunk={args.chunk}]",
+                        run_chunked_to_quiescence, cfg, st0,
+                        args.chunk, max_cycles)
+                hlo_fp = (run_rec.get("hlo_fingerprint")
+                          or per_rec.get("hlo_fingerprint"))
+                cost = roofline.cost_vector(per_rec, run_rec,
+                                            steps(state), retired)
+        except Exception as e:   # recording must never kill the bench
+            print(f"note: cost vector unavailable: {e}",
+                  file=sys.stderr)
         fingerprint = {
             "engine": args.engine, "workload": args.workload,
             "nodes": args.nodes, "trace_len": args.trace_len,
@@ -458,7 +491,9 @@ def main():
             result=result, extra=extra, config=fingerprint,
             sha=history.git_sha(os.path.dirname(
                 os.path.abspath(__file__))),
-            captured_at=time.strftime("%Y-%m-%dT%H:%M:%S%z"))
+            captured_at=time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            device_kind=device_kind, hlo_fingerprint=hlo_fp,
+            cost=cost)
         history.append(args.record, doc)
         print(f"recorded to {args.record}", file=sys.stderr)
 

@@ -37,6 +37,13 @@
 # (exit 0) and a synthetic +12% slowdown as a regression (exit 4) —
 # the detector's own mutation test — then a tiny CPU bench run is
 # recorded into a throwaway history and diffed --against-last.
+#
+# The perf smoke (obs v4) runs `cache-sim perf-report` twice on a mini
+# async config and requires byte-identical JSON (the default report is
+# deterministic by contract — timing is opt-in), then exercises the
+# exact bytes/instr gate over the history the bench smoke just
+# recorded: head vs itself must pass (exit 0) and a synthetic +20%
+# bytes vector must be a regression (exit 4). Both boxed ≤30 s.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -98,6 +105,25 @@ timeout -k 5 300 python bench.py --smoke --engine async --reps 2 \
     --record "$BENCH_HIST" > /dev/null
 python -m ue22cs343bb1_openmp_assignment_tpu.cli bench-diff \
     --history "$BENCH_HIST" --against-last
+
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.cli \
+    perf-report --engine async --nodes 2 --trace-len 4 --chunk 4 \
+    --json --out /tmp/_perf_smoke_a.json
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.cli \
+    perf-report --engine async --nodes 2 --trace-len 4 --chunk 4 \
+    --json --out /tmp/_perf_smoke_b.json
+cmp /tmp/_perf_smoke_a.json /tmp/_perf_smoke_b.json
+echo "perf-report smoke: ok (deterministic)"
+python -m ue22cs343bb1_openmp_assignment_tpu.cli bench-diff \
+    --history "$BENCH_HIST" --against-last --bytes
+rc=0
+python -m ue22cs343bb1_openmp_assignment_tpu.cli bench-diff \
+    "$BENCH_HIST" --synthetic-bytes 20 || rc=$?
+if [[ "$rc" != 4 ]]; then
+    echo "bytes-gate self-test FAILED: synthetic +20% bytes" \
+         "exited $rc, want 4" >&2
+    exit 1
+fi
 
 if [[ "${1:-}" == "--analyze" ]]; then
     exit 0

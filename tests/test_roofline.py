@@ -1,0 +1,334 @@
+"""obs v4: roofline attribution, the exact bytes/instr gate, and the
+bench-history dashboard.
+
+Anchors pinned here: cost normalization across every shape XLA has
+shipped (dict / list / None / junk), the full bytes-gate rc matrix
+(pass 0 / synthetic +20% regression 4 / cross-device incomparable 2),
+bench-history schema v1.2 backward compatibility (v1 and v1.1 docs
+still validate, and may NOT smuggle newer keys), the multichip ingest
+(32/32/64/65536/65536 from the archived dryruns), and the dashboard
+golden render from exactly the ten committed captures.
+"""
+
+import copy
+import json
+import os
+
+import pytest
+
+from ue22cs343bb1_openmp_assignment_tpu import cli
+from ue22cs343bb1_openmp_assignment_tpu.obs import (dashboard, history,
+                                                    regress, roofline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "golden")
+BENCH = [os.path.join(REPO, f"BENCH_r0{i}.json") for i in range(1, 6)]
+MULTI = [os.path.join(REPO, f"MULTICHIP_r0{i}.json")
+         for i in range(1, 6)]
+
+
+def run_cli(args, capsys):
+    rc = cli.main(args)
+    out = capsys.readouterr()
+    return rc, out.out, out.err
+
+
+# -- cost normalization across backend shapes ------------------------------
+
+
+def test_normalize_cost_dict_passthrough():
+    c = roofline.normalize_cost({"flops": 10, "bytes accessed": 40.0})
+    assert c == {"flops": 10.0, "bytes accessed": 40.0}
+
+
+def test_normalize_cost_sums_list_of_computations():
+    c = roofline.normalize_cost([{"flops": 10.0}, {"flops": 5.0,
+                                                   "bytes accessed": 8}])
+    assert c == {"flops": 15.0, "bytes accessed": 8.0}
+
+
+def test_normalize_cost_none_and_empty_are_unavailable_not_keyerror():
+    # the CPU backend under JAX_PLATFORMS=cpu has returned None and []
+    # across jax versions; both must collapse to {} (ISSUE 7 satellite)
+    assert roofline.normalize_cost(None) == {}
+    assert roofline.normalize_cost([]) == {}
+    assert roofline.normalize_cost("junk") == {}
+    assert roofline.normalize_cost([{"flops": "n/a"}, 7]) == {}
+
+
+def test_profiler_normalize_delegates_and_marks_unavailable():
+    from ue22cs343bb1_openmp_assignment_tpu.obs import profiler
+    assert profiler._normalize_cost(None) == {}
+    assert profiler._normalize_cost({"flops": 1}) == {"flops": 1.0}
+
+
+# -- device peaks ----------------------------------------------------------
+
+
+def test_device_peaks_static_table_and_fallback():
+    p = roofline.device_peaks("TPU v5 lite")
+    assert p["source"] == "static_table"
+    assert p["ridge_flops_per_byte"] == pytest.approx(197e12 / 819e9)
+    q = roofline.device_peaks("quantum-abacus-9000")
+    assert q["source"] == "generic_fallback"
+    assert q["ridge_flops_per_byte"] > 0
+
+
+# -- classification + cost vector ------------------------------------------
+
+
+def _rec(name, flops, hbm):
+    return {"name": name, "flops": float(flops),
+            "hbm_bytes": float(hbm), "output_bytes": 0.0,
+            "cost_available": True, "hlo_fingerprint": "f" * 16}
+
+
+def test_classify_bound_verdicts():
+    peaks = roofline.device_peaks("cpu")      # ridge = 2.5 flop/B
+    low = roofline.classify(_rec("k", 10, 100), peaks)    # AI = 0.1
+    assert low["bound"] == "hbm" and low["ceiling_frac"] < 1
+    hi = roofline.classify(_rec("k", 1000, 100), peaks)   # AI = 10
+    assert hi["bound"] == "compute" and hi["ceiling_frac"] == 1.0
+    na = roofline.classify({"name": "k", "flops": None,
+                            "hbm_bytes": None, "output_bytes": None,
+                            "cost_available": False}, peaks)
+    assert na["bound"] == "cost_unavailable"
+
+
+def test_cost_vector_bytes_per_instr_arithmetic():
+    vec = roofline.cost_vector(_rec("step", 50, 1000), None,
+                               steps=8, retired=64)
+    assert vec["bytes_per_instr"] == pytest.approx(1000 * 8 / 64)
+    assert vec["flops_per_instr"] == pytest.approx(50 * 8 / 64)
+    assert vec["cost_available"] and "step" in vec["kernels"]
+
+
+def test_build_report_ranks_by_traffic_and_flags_per_step():
+    recs = [_rec("small", 1, 10), _rec("big", 1, 10_000)]
+    doc = roofline.build_report("deep", {"nodes": 4}, recs, "small",
+                                steps=2, retired=8, device_kind="cpu")
+    assert doc["top_hbm_kernel"] == "big"
+    assert [k["name"] for k in doc["kernels"]] == ["big", "small"]
+    assert [k["per_step"] for k in doc["kernels"]] == [False, True]
+    assert doc["bytes_per_instr"] == pytest.approx(10 * 2 / 8)
+    roofline.render_text(doc)   # must not raise
+    with pytest.raises(ValueError):
+        roofline.build_report("deep", {}, recs, "absent", 1, 1,
+                              device_kind="cpu")
+
+
+# -- the exact bytes/instr gate --------------------------------------------
+
+
+def _entry(label, bpi=100.0, kernels=None, device="cpu", hlo="a" * 16):
+    cost = {"per_step_kernel": "step", "steps": 8, "retired": 64,
+            "bytes_per_instr": bpi, "flops_per_instr": 10.0,
+            "cost_available": True,
+            "kernels": kernels or {"step": {"flops": 80.0,
+                                            "hbm_bytes": 800.0,
+                                            "output_bytes": 0.0,
+                                            "cost_available": True}}}
+    return history.entry(
+        label=label, source="test",
+        result={"metric": "m", "value": 1.0, "unit": "instrs/sec"},
+        extra={"engine": "deep", "rep_times_s": [1.0, 1.1, 1.2]},
+        device_kind=device, hlo_fingerprint=hlo, cost=cost)
+
+
+def test_compare_cost_rc_matrix():
+    a = _entry("a")
+    assert regress.compare_cost(a, copy.deepcopy(a))["verdict"] == \
+        "pass"
+    # +20% bytes: deterministic regression naming the kernel
+    b = _entry("b", bpi=120.0,
+               kernels={"step": {"flops": 80.0, "hbm_bytes": 960.0,
+                                 "output_bytes": 0.0,
+                                 "cost_available": True}})
+    rep = regress.compare_cost(a, b)
+    assert rep["verdict"] == "regression"
+    assert rep["offending_kernels"][0]["name"] == "step"
+    regress.format_cost_report(rep)   # must not raise
+    # -20%: improvement, never a gate failure
+    assert regress.compare_cost(b, a)["verdict"] == "improvement"
+    # inside tolerance: pass
+    assert regress.compare_cost(a, _entry("c", bpi=101.0),
+                                tol_pct=2.0)["verdict"] == "pass"
+    # no cost on one side -> incomparable
+    plain = _entry("p")
+    plain["cost"] = None
+    assert regress.compare_cost(a, plain)["verdict"] == "incomparable"
+    # cross-device -> incomparable before any numbers are read
+    tpu = _entry("t", device="TPU v5e")
+    rep = regress.compare_cost(a, tpu)
+    assert rep["verdict"] == "incomparable"
+    assert "device" in rep["detail"]
+
+
+def test_compare_times_refuses_cross_device_and_flags_hlo():
+    a, b = _entry("a"), _entry("b", device="TPU v5e")
+    rep = regress.compare(a, b)
+    assert rep["verdict"] == "incomparable"
+    assert "device_mismatch" in rep["flags"]
+    c = _entry("c", hlo="b" * 16)
+    assert "hlo_changed" in regress.compare(a, c)["flags"]
+
+
+def test_bench_diff_bytes_cli_rc_matrix(tmp_path, capsys):
+    hist = str(tmp_path / "h.jsonl")
+    history.append(hist, _entry("a"))
+    history.append(hist, _entry("b"))
+    rc, _, _ = run_cli(["bench-diff", "--history", hist,
+                        "--against-last", "--bytes"], capsys)
+    assert rc == 0
+    rc, _, _ = run_cli(["bench-diff", hist, "--synthetic-bytes", "20"],
+                       capsys)
+    assert rc == 4
+    history.append(hist, _entry("c", device="TPU v5e"))
+    rc, out, _ = run_cli(["bench-diff", "--history", hist,
+                          "--against-last", "--bytes"], capsys)
+    assert rc == 2 and "different device" in out
+
+
+# -- schema v1.2 backcompat ------------------------------------------------
+
+
+def test_schema_v12_backcompat_matrix():
+    v12 = _entry("x")
+    assert v12["schema"] == "cache-sim/bench/v1.2"
+    history.validate_entry(v12)
+    # v1.1: comparability keys allowed, cost NOT
+    v11 = copy.deepcopy(v12)
+    v11["schema"] = "cache-sim/bench/v1.1"
+    del v11["cost"]
+    history.validate_entry(v11)
+    v11_bad = copy.deepcopy(v11)
+    v11_bad["cost"] = {"kernels": {}}
+    with pytest.raises(ValueError, match="unknown key: cost"):
+        history.validate_entry(v11_bad)
+    # v1: neither generation of optional keys
+    v1 = copy.deepcopy(v12)
+    v1["schema"] = "cache-sim/bench/v1"
+    for k in ("cost", "device_kind", "hlo_fingerprint"):
+        del v1[k]
+    history.validate_entry(v1)
+    v1_bad = copy.deepcopy(v1)
+    v1_bad["device_kind"] = "cpu"
+    with pytest.raises(ValueError, match="unknown key: device_kind"):
+        history.validate_entry(v1_bad)
+    # malformed cost is rejected even on v1.2
+    bad = copy.deepcopy(v12)
+    bad["cost"] = {"bytes_per_instr": -1}
+    with pytest.raises(ValueError):
+        history.validate_entry(bad)
+
+
+def test_archived_v1_ingest_still_validates():
+    # the adapters emit the current schema id, and archived captures
+    # keep loading (the whole point of the compat window)
+    doc = history.ingest_capture(BENCH[2])
+    history.validate_entry(doc)
+
+
+# -- multichip ingest ------------------------------------------------------
+
+
+def test_ingest_multichip_scaling_ladder():
+    vals = [history.ingest_multichip(p) for p in MULTI]
+    assert [int(v["value"]) for v in vals] == [32, 32, 64, 65536,
+                                               65536]
+    assert vals[0]["label"] == "mc-r01"
+    assert all(v["config"]["kind"] == "multichip" for v in vals)
+    assert all(v["rep_times_s"] == [] for v in vals)
+
+
+def test_ingest_multichip_rejects_non_multichip(tmp_path):
+    p = tmp_path / "x.json"
+    p.write_text(json.dumps({"nope": 1}))
+    with pytest.raises(ValueError, match="n_devices"):
+        history.ingest_multichip(str(p))
+    p.write_text(json.dumps({"n_devices": 4, "tail": "no markers"}))
+    with pytest.raises(ValueError, match="nodes"):
+        history.ingest_multichip(str(p))
+
+
+# -- dashboard -------------------------------------------------------------
+
+
+def _archive_entries():
+    return ([history.ingest_capture(p) for p in BENCH]
+            + [history.ingest_multichip(p) for p in MULTI])
+
+
+def test_dashboard_model_from_archive():
+    m = dashboard.build_model(_archive_entries())
+    assert len(m["headline"]) == 5
+    # the 1.9e7 plateau the ISSUE names
+    assert m["headline"][-1]["value"] == pytest.approx(1.896e7,
+                                                       rel=0.01)
+    assert m["target"] == pytest.approx(1e8)
+    assert [int(s["nodes"]) for s in m["scaling"]] == [32, 32, 64,
+                                                       65536, 65536]
+    verdicts = [v["verdict"] for v in m["verdicts"]]
+    assert "noise" in verdicts            # r03 -> r04, PERF.md's call
+    assert "mesi/uniform" in m["cells"]
+    assert m["roofline"] == []            # archives predate v1.2
+
+
+def test_dashboard_roofline_points_from_cost_vector():
+    entries = _archive_entries() + [_entry("live")]
+    m = dashboard.build_model(entries)
+    assert len(m["roofline"]) == 1
+    pt = m["roofline"][0]
+    assert pt["kernel"] == "step"
+    assert pt["ai"] == pytest.approx(80.0 / 800.0)
+    # both artifacts must render the scatter without raising
+    assert "roofline" in dashboard.render_html(m)
+    assert "| live | step |" in dashboard.render_markdown(m)
+
+
+def test_dashboard_golden_render(tmp_path, capsys):
+    html = str(tmp_path / "dashboard.html")
+    md = str(tmp_path / "dashboard.md")
+    rc, _, err = run_cli(["dashboard"] + BENCH + MULTI
+                         + ["--html", html, "--md", md], capsys)
+    assert rc == 0 and "wrote" in err
+    for got, want in ((html, "dashboard.html"), (md, "dashboard.md")):
+        with open(got) as f, open(os.path.join(GOLDEN, want)) as g:
+            assert f.read() == g.read(), (
+                f"{want} drifted from tests/golden/{want} — if the "
+                "change is intentional, regenerate with: cache-sim "
+                "dashboard BENCH_r0*.json MULTICHIP_r0*.json "
+                f"--html/--md tests/golden/{want}")
+    with open(html) as f:
+        page = f.read()
+    assert "target 1e+08 instrs/sec" in page     # the north-star line
+    assert page.count("<svg") == 2               # headline + scaling
+
+
+def test_dashboard_cli_usage_errors(capsys):
+    rc, _, err = run_cli(["dashboard"], capsys)
+    assert rc == 2 and "provide" in err
+    rc, _, err = run_cli(["dashboard", BENCH[0]], capsys)
+    assert rc == 2 and "--html" in err
+
+
+# -- perf-report CLI -------------------------------------------------------
+
+
+def test_perf_report_cli_smoke(capsys):
+    rc, out, _ = run_cli(["perf-report", "--engine", "async",
+                          "--nodes", "2", "--trace-len", "4",
+                          "--chunk", "4", "--json"], capsys)
+    assert rc == 0
+    doc = json.loads(out)
+    assert doc["schema"] == "cache-sim/perfreport/v1"
+    assert doc["per_step_kernel"] == "step.cycle"
+    names = [k["name"] for k in doc["kernels"]]
+    assert "step.cycle" in names and "mailbox.dequeue" in names
+    if doc["cost_available"]:   # CPU exposes the cost model today
+        assert doc["bytes_per_instr"] > 0
+        assert doc["bound"] in ("hbm", "compute")
+        assert doc["top_hbm_kernel"] in names
+    else:
+        assert doc["bound"] == "cost_unavailable"
+    assert "timing" not in doc   # deterministic by default

@@ -12,12 +12,24 @@ One event/metric surface for all engines:
   device_get sync), wired into bench.py.
 - :mod:`obs.timeseries` — host rendering of the on-device telemetry
   samples (ops.step.run_cycles_telemetry).
-- :mod:`obs.history` — append-only ``cache-sim/bench/v1`` benchmark
-  history (full rep vectors + config fingerprint + git sha), fed by
-  ``bench.py --record`` and by ingesting archived ``BENCH_r*.json``.
+- :mod:`obs.history` — append-only ``cache-sim/bench/v1.2`` benchmark
+  history (full rep vectors + config fingerprint + git sha + device
+  kind / HLO fingerprint / cost vector; v1 and v1.1 entries validate
+  unchanged), fed by ``bench.py --record`` and by ingesting archived
+  ``BENCH_r*.json`` and ``MULTICHIP_r*.json`` captures.
 - :mod:`obs.regress` — noise-aware bench comparator (exact
   Mann-Whitney U on rep times + a practical bar from recorded rep
-  spread), the brain of ``cache-sim bench-diff``.
+  spread), the brain of ``cache-sim bench-diff``; plus the exact
+  bytes/instr comparator behind ``bench-diff --bytes`` (deterministic
+  cost vectors need no statistics).
+- :mod:`obs.roofline` — roofline memory-traffic attribution (Williams
+  et al., PAPERS.md): per-kernel flops / HBM bytes / arithmetic
+  intensity vs device peaks, bytes per simulated instruction, and the
+  HBM/compute/dispatch bound classification behind ``cache-sim
+  perf-report``.
+- :mod:`obs.dashboard` — deterministic self-contained HTML + markdown
+  render of the bench history (headline vs the 1e8 target, verdict
+  strip, coverage cells, multichip scaling curve, roofline scatter).
 - :mod:`obs.profiler` — ``jax.profiler`` trace capture around engine
   runs, per-kernel compiled cost attribution folded into PhaseTimer
   reports, and the timer self-check re-asserting PERF.md's
@@ -27,7 +39,8 @@ One event/metric surface for all engines:
   doc + Perfetto trace + analysis/shrink repro) on invariant trips,
   watchdog hangs, and fuzzer findings.
 - :mod:`obs.cli` — the ``cache-sim stats`` / ``cache-sim trace`` /
-  ``cache-sim bench-diff`` subcommands.
+  ``cache-sim bench-diff`` / ``cache-sim perf-report`` /
+  ``cache-sim dashboard`` subcommands.
 
 Everything in this package is host-side: it renders device arrays after
 the run; nothing here is traced (the on-device capture lives in

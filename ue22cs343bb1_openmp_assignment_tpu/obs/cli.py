@@ -561,6 +561,23 @@ def build_bench_diff_parser() -> argparse.ArgumentParser:
                    help="self-test: compare A against a copy of A "
                         "with rep times scaled by (1 + PCT/100) — "
                         "must come out a regression (exit 4)")
+    p.add_argument("--bytes", action="store_true",
+                   help="compare the recorded deterministic cost "
+                        "vectors (obs.roofline, bench.py --record) "
+                        "instead of rep times: bytes/instr is exact "
+                        "per compiled HLO, so any increase beyond "
+                        "--bytes-tol is a regression with the "
+                        "offending kernels named — no statistics")
+    p.add_argument("--bytes-tol", type=float, default=None,
+                   metavar="PCT",
+                   help="tolerance for the --bytes gate (default 2.0; "
+                        "absorbs benign layout churn, not noise — "
+                        "there is none)")
+    p.add_argument("--synthetic-bytes", type=float, metavar="PCT",
+                   help="self-test (implies --bytes): compare A "
+                        "against a copy of A with its cost vector "
+                        "scaled by (1 + PCT/100) — must come out a "
+                        "regression (exit 4)")
     p.add_argument("--min-effect", type=float, default=5.0,
                    metavar="PCT",
                    help="never flag deltas below this percent "
@@ -598,6 +615,13 @@ def cmd_bench_diff(args) -> int:
         print(f"error: {msg}", file=sys.stderr)
         return 2
 
+    want_bytes = args.bytes or args.synthetic_bytes is not None
+    if args.bytes_tol is not None and not want_bytes:
+        return fail("--bytes-tol only applies with --bytes")
+    if (args.synthetic_bytes is not None
+            and args.synthetic_slowdown is not None):
+        return fail("--synthetic-bytes and --synthetic-slowdown are "
+                    "exclusive")
     try:
         if args.against_last:
             if not args.history:
@@ -625,21 +649,42 @@ def cmd_bench_diff(args) -> int:
                                     f"*{scale:g} (synthetic)")
                 entry_b["rep_times_s"] = [
                     t * scale for t in entry_a["rep_times_s"]]
+            elif args.synthetic_bytes is not None:
+                scale = 1.0 + args.synthetic_bytes / 100.0
+                entry_b = copy.deepcopy(entry_a)
+                entry_b["label"] = (f"{entry_a['label']}"
+                                    f"*{scale:g}B (synthetic)")
+                cost = entry_b.get("cost")
+                if isinstance(cost, dict):
+                    if cost.get("bytes_per_instr") is not None:
+                        cost["bytes_per_instr"] = round(
+                            cost["bytes_per_instr"] * scale, 6)
+                    for k in (cost.get("kernels") or {}).values():
+                        if k.get("hbm_bytes") is not None:
+                            k["hbm_bytes"] = k["hbm_bytes"] * scale
             elif args.b:
                 entry_b = _load_bench_entry(args.b)
             else:
                 return fail("provide capture B (or "
-                            "--synthetic-slowdown PCT)")
+                            "--synthetic-slowdown/--synthetic-bytes "
+                            "PCT)")
     except (OSError, ValueError) as e:
         return fail(str(e))
 
-    rep = regress.compare(entry_a, entry_b,
-                          min_effect=args.min_effect / 100.0,
-                          alpha=args.alpha)
+    if want_bytes:
+        tol = (regress.DEFAULT_BYTES_TOL_PCT if args.bytes_tol is None
+               else args.bytes_tol)
+        rep = regress.compare_cost(entry_a, entry_b, tol_pct=tol)
+        fmt = regress.format_cost_report
+    else:
+        rep = regress.compare(entry_a, entry_b,
+                              min_effect=args.min_effect / 100.0,
+                              alpha=args.alpha)
+        fmt = regress.format_report
     if args.json:
         print(json.dumps(rep, sort_keys=True))
     else:
-        print(regress.format_report(rep))
+        print(fmt(rep))
     if rep["verdict"] == "regression":
         return 4
     if rep["verdict"] == "incomparable":
@@ -650,6 +695,276 @@ def cmd_bench_diff(args) -> int:
 # lint: host
 def main_bench_diff(argv) -> int:
     return cmd_bench_diff(build_bench_diff_parser().parse_args(argv))
+
+
+# lint: host
+def build_perfreport_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cache-sim perf-report",
+        description="roofline + memory-traffic attribution "
+                    "(obs.roofline): per-kernel flops / HBM bytes / "
+                    "arithmetic intensity / bound classification from "
+                    "XLA's compiled cost analysis, reduced to "
+                    "bytes per simulated instruction — the one-screen "
+                    "answer to which kernel moves the bytes. The "
+                    "default report is deterministic per build "
+                    "(byte-identical across runs); wall-clock ns/instr "
+                    "is opt-in via --timing.")
+    _add_common(p)
+    p.add_argument("--engine", choices=["async", "sync", "deep"],
+                   default="deep",
+                   help="engine to attribute (default deep — the "
+                        "throughput path ROADMAP item 1 targets)")
+    p.add_argument("--chunk", type=int, default=64,
+                   help="cycles/rounds per quiescence-check chunk")
+    p.add_argument("--pallas", action="store_true",
+                   help="sync-family engines on a TPU backend: "
+                        "attribute the fused Pallas kernel variants "
+                        "(cfg.pallas_burst) instead of the XLA path")
+    p.add_argument("--timing", action="store_true",
+                   help="attach the nondeterministic half: measured "
+                        "ns/instr split by PhaseTimer phase and the "
+                        "roofline model share per kernel, plus the "
+                        "dispatch-bound check (measured >> model)")
+    p.add_argument("--reps", type=int, default=3,
+                   help="timed repetitions for --timing (default 3)")
+    p.add_argument("--device-kind", default=None,
+                   help="classify against this device kind's peaks "
+                        "instead of the detected one (obs.roofline "
+                        "static table)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the full cache-sim/perfreport/v1 doc")
+    p.add_argument("--out", metavar="PATH",
+                   help="write the report here instead of stdout")
+    return p
+
+
+# lint: host
+def cmd_perfreport(args) -> int:
+    import dataclasses
+    import time
+
+    import jax
+    import numpy as np
+
+    from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+    from ue22cs343bb1_openmp_assignment_tpu.models.system import (
+        CoherenceSystem)
+    from ue22cs343bb1_openmp_assignment_tpu.obs import roofline
+    from ue22cs343bb1_openmp_assignment_tpu.obs.phases import PhaseTimer
+    from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+    from ue22cs343bb1_openmp_assignment_tpu.ops import mailbox, step
+
+    if args.test_dir:
+        print("error: perf-report attributes synthetic workloads; "
+              "use --workload (default uniform)", file=sys.stderr)
+        return 2
+    wl = args.workload or "uniform"
+    sync_like = args.engine in ("sync", "deep")
+    if sync_like:
+        cfg = SystemConfig.scale(
+            num_nodes=args.nodes,
+            drain_depth=13 if args.engine == "deep" else 4,
+            txn_width=3)
+    else:
+        cfg = SystemConfig.scale(num_nodes=args.nodes)
+    if args.engine == "deep":
+        # mirror bench.py's measured-best deep defaults so the report
+        # attributes the same program the headline measures
+        cfg = dataclasses.replace(
+            cfg, deep_window=True,
+            deep_slots=2 if args.nodes >= 32768 else 3,
+            deep_ownerval_slots=1, deep_horizon_slack=4,
+            deep_waves=1, deep_read_storm=False, deep_exact_flags=True)
+    if args.pallas:
+        if sync_like and jax.default_backend() == "tpu":
+            cfg = dataclasses.replace(cfg, pallas_burst=True)
+        else:
+            print("note: --pallas needs a sync-family engine on a TPU "
+                  "backend; attributing the XLA path instead",
+                  file=sys.stderr)
+    system = CoherenceSystem.from_workload(
+        cfg, wl, trace_len=args.trace_len, seed=args.seed)
+
+    max_cycles = args.max_cycles
+    chunk = args.chunk
+    if sync_like:
+        max_cycles = min(max_cycles, se.claim_max_rounds(cfg) - 1)
+        st0 = se.from_sim_state(cfg, system.state, seed=args.seed)
+
+        def run():
+            return se.run_sync_to_quiescence(cfg, st0, chunk,
+                                             max_cycles)
+
+        def steps_of(st):
+            return int(st.metrics.rounds)
+
+        per_step_name = "sync.round_step"
+
+        def records():
+            return [
+                roofline.kernel_record(
+                    per_step_name,
+                    jax.jit(lambda s: se.round_step(cfg, s)), st0),
+                roofline.kernel_record(
+                    f"sync.run_to_quiescence[chunk={chunk}]",
+                    se._run_sync_jit, cfg, st0, chunk, max_cycles),
+            ]
+    else:
+        st0 = system.state
+
+        def run():
+            return step.run_chunked_to_quiescence(cfg, st0, chunk,
+                                                  max_cycles)
+
+        def steps_of(st):
+            return int(st.metrics.cycles)
+
+        per_step_name = "step.cycle"
+
+        def records():
+            return [
+                roofline.kernel_record(
+                    per_step_name,
+                    jax.jit(lambda s: step.cycle(cfg, s)), st0),
+                roofline.kernel_record(
+                    "mailbox.dequeue",
+                    jax.jit(lambda s: mailbox.dequeue(cfg, s)), st0),
+                roofline.kernel_record(
+                    f"step.run_chunked[chunk={chunk}]",
+                    step.run_chunked_to_quiescence, cfg, st0, chunk,
+                    max_cycles),
+            ]
+
+    # one real run pins the deterministic integers (steps, retired)
+    # that turn per-step bytes into bytes/instr
+    final = run()
+    steps = steps_of(final)
+    retired = int(np.sum(np.asarray(final.metrics.instrs_retired)))
+    if not bool(final.quiescent()):
+        print(f"warning: not quiescent within {max_cycles} "
+              f"cycles/rounds; bytes/instr covers the truncated run",
+              file=sys.stderr)
+    doc = roofline.build_report(
+        args.engine,
+        {"nodes": args.nodes, "workload": wl,
+         "trace_len": args.trace_len, "chunk": chunk,
+         "seed": args.seed,
+         "pallas": bool(getattr(cfg, "pallas_burst", False))},
+        records(), per_step_name, steps, retired,
+        device_kind=args.device_kind)
+    if args.timing:
+        timer = PhaseTimer()
+        rep_times = []
+        for _ in range(max(1, args.reps)):
+            t0 = time.perf_counter()
+            st = run()
+            t1 = time.perf_counter()
+            # device_get is the real sync on a tunneled link (PERF.md)
+            int(np.sum(np.asarray(st.metrics.instrs_retired)))
+            t2 = time.perf_counter()
+            timer.add("execute_dispatch", t1 - t0)
+            timer.add("device_get_sync", t2 - t1)
+            rep_times.append(t2 - t0)
+        doc["timing"] = roofline.timing_section(
+            timer.report(), doc["kernels"], steps, retired, rep_times)
+    if args.json:
+        _emit(args, doc)
+    else:
+        text = roofline.render_text(doc)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text)
+        else:
+            sys.stdout.write(text)
+    return 0
+
+
+# lint: host
+def main_perfreport(argv) -> int:
+    args = build_perfreport_parser().parse_args(argv)
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    return cmd_perfreport(args)
+
+
+# lint: host
+def build_dashboard_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cache-sim dashboard",
+        description="render a bench history into a self-contained "
+                    "static HTML + markdown report (obs.dashboard): "
+                    "headline instrs/sec trend vs the 1e8 target, "
+                    "bench-diff verdict strip, protocol x workload "
+                    "coverage cells, the multichip sharded scaling "
+                    "curve, and the roofline scatter of recorded cost "
+                    "vectors. Deterministic: same history bytes, same "
+                    "report bytes.")
+    p.add_argument("captures", nargs="*",
+                   help="capture files to ingest before rendering: "
+                        "BENCH_r*.json driver captures and "
+                        "MULTICHIP_r*.json dryruns (obs.history "
+                        "adapters), in the order given")
+    p.add_argument("--history", metavar="PATH",
+                   help="bench history JSONL (bench.py --record); its "
+                        "entries precede any ingested captures")
+    p.add_argument("--html", metavar="PATH",
+                   help="write the self-contained HTML report here")
+    p.add_argument("--md", metavar="PATH",
+                   help="write the markdown report here")
+    p.add_argument("--json", action="store_true",
+                   help="print the dashboard model JSON to stdout")
+    return p
+
+
+# lint: host
+def _ingest_any(path: str) -> dict:
+    """Capture path -> history entry, dispatching between the bench
+    and multichip adapters by content (filename is a hint only)."""
+    from ue22cs343bb1_openmp_assignment_tpu.obs import history
+    if "MULTICHIP" in os.path.basename(path).upper():
+        return history.ingest_multichip(path)
+    try:
+        return history.ingest_capture(path)
+    except ValueError:
+        return history.ingest_multichip(path)
+
+
+# lint: host
+def cmd_dashboard(args) -> int:
+    from ue22cs343bb1_openmp_assignment_tpu.obs import (
+        dashboard, history)
+    if not args.history and not args.captures:
+        print("error: provide --history PATH and/or capture files",
+              file=sys.stderr)
+        return 2
+    if not (args.html or args.md or args.json):
+        print("error: provide --html PATH, --md PATH, or --json",
+              file=sys.stderr)
+        return 2
+    entries = []
+    try:
+        if args.history:
+            entries.extend(history.load(args.history))
+        for path in args.captures:
+            entries.append(_ingest_any(path))
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    res = dashboard.render(entries, html_path=args.html,
+                           md_path=args.md)
+    if args.json:
+        print(json.dumps(res["model"], sort_keys=True))
+    for path in (args.html, args.md):
+        if path:
+            print(f"wrote {path}", file=sys.stderr)
+    return 0
+
+
+# lint: host
+def main_dashboard(argv) -> int:
+    return cmd_dashboard(build_dashboard_parser().parse_args(argv))
 
 
 # lint: host

@@ -26,13 +26,25 @@ import os
 import subprocess
 from typing import List, Optional
 
-SCHEMA_ID = "cache-sim/bench/v1"
+SCHEMA_ID = "cache-sim/bench/v1.2"
+
+#: older schema ids; validate_entry accepts docs under any of these,
+#: with only the optional keys their version introduced
+SCHEMA_V1 = "cache-sim/bench/v1"
+SCHEMA_V11 = "cache-sim/bench/v1.1"
 
 #: entry keys, all always present (None marks "not captured")
 _TOP_KEYS = ("schema", "label", "source", "captured_at", "git_sha",
              "metric", "unit", "value", "vs_baseline", "config",
              "rep_times_s", "elapsed_s", "steps", "retired",
              "quiescent", "phases")
+
+#: v1.1 added the comparability keys (bench-diff refuses to compare
+#: rep times across devices); v1.2 added the deterministic cost
+#: vector (obs.roofline.cost_vector — the --bytes gate's input).
+#: Optional: absent and None both mean "not captured".
+_OPT_KEYS_V11 = ("device_kind", "hlo_fingerprint")
+_OPT_KEYS_V12 = _OPT_KEYS_V11 + ("cost",)
 
 
 # lint: host
@@ -51,15 +63,21 @@ def git_sha(repo_dir: Optional[str] = None) -> Optional[str]:
 # lint: host
 def entry(label: str, source: str, result: dict, extra: dict,
           config: Optional[dict] = None, sha: Optional[str] = None,
-          captured_at: Optional[str] = None) -> dict:
-    """Build a v1 entry from bench.py's two JSON lines.
+          captured_at: Optional[str] = None,
+          device_kind: Optional[str] = None,
+          hlo_fingerprint: Optional[str] = None,
+          cost: Optional[dict] = None) -> dict:
+    """Build a v1.2 entry from bench.py's two JSON lines.
 
     ``result`` is the stdout line ({metric, value, unit, vs_baseline});
     ``extra`` is the stderr line (engine, rep_times_s, quiescent, ...).
     ``config`` is the benchmark fingerprint — whatever knobs determined
     the measured computation; the metric string itself is always part
     of the comparability check, so a partial fingerprint degrades
-    gracefully for archived captures.
+    gracefully for archived captures. ``device_kind`` /
+    ``hlo_fingerprint`` make cross-device comparisons detectable;
+    ``cost`` is the deterministic roofline cost vector
+    (obs.roofline.cost_vector) behind ``bench-diff --bytes``.
     """
     doc = {
         "schema": SCHEMA_ID,
@@ -82,26 +100,54 @@ def entry(label: str, source: str, result: dict, extra: dict,
         "quiescent": (bool(extra["quiescent"])
                       if extra.get("quiescent") is not None else None),
         "phases": extra.get("phases"),
+        "device_kind": device_kind,
+        "hlo_fingerprint": hlo_fingerprint,
+        "cost": cost,
     }
     return validate_entry(doc)
 
 
 # lint: host
 def validate_entry(doc: dict) -> dict:
-    """Check an entry against the v1 schema; returns the doc, raises
+    """Check an entry against the schema (v1.2, or v1/v1.1 unchanged
+    for backward compatibility — an old doc may only carry the
+    optional keys its version introduced); returns the doc, raises
     ValueError listing every violation (same contract as
     obs.schema.validate)."""
     errs = []
     if not isinstance(doc, dict):
         raise ValueError(f"entry must be a dict, got {type(doc).__name__}")
+    sid = doc.get("schema")
+    allowed = _TOP_KEYS + (
+        _OPT_KEYS_V12 if sid == SCHEMA_ID
+        else _OPT_KEYS_V11 if sid == SCHEMA_V11 else ())
     for k in _TOP_KEYS:
         if k not in doc:
             errs.append(f"missing key: {k}")
     for k in doc:
-        if k not in _TOP_KEYS:
+        if k not in allowed:
             errs.append(f"unknown key: {k}")
-    if doc.get("schema") != SCHEMA_ID:
-        errs.append(f"schema must be {SCHEMA_ID!r}, got {doc.get('schema')!r}")
+    if sid not in (SCHEMA_ID, SCHEMA_V11, SCHEMA_V1):
+        errs.append(f"schema must be {SCHEMA_ID!r} (or the "
+                    f"backward-compatible {SCHEMA_V11!r}/{SCHEMA_V1!r}),"
+                    f" got {sid!r}")
+    for k in _OPT_KEYS_V11:
+        v = doc.get(k)
+        if v is not None and (not isinstance(v, str) or not v):
+            errs.append(f"{k} must be None or a non-empty string")
+    cost = doc.get("cost")
+    if cost is not None:
+        if not isinstance(cost, dict) or not isinstance(
+                cost.get("kernels"), dict):
+            errs.append("cost must be None or a dict with a 'kernels' "
+                        "dict (obs.roofline.cost_vector)")
+        else:
+            bpi = cost.get("bytes_per_instr")
+            if bpi is not None and (
+                    not isinstance(bpi, (int, float))
+                    or isinstance(bpi, bool) or bpi < 0):
+                errs.append("cost.bytes_per_instr must be None or a "
+                            f"non-negative number, got {bpi!r}")
     for k in ("label", "source", "metric", "unit"):
         if not isinstance(doc.get(k), str) or not doc.get(k):
             errs.append(f"{k} must be a non-empty string")
@@ -208,6 +254,64 @@ def ingest_capture(path: str, label: Optional[str] = None) -> dict:
         cfg["cmd"] = cmd
     return entry(label, os.path.basename(path), result, extra,
                  config=cfg)
+
+
+# lint: host
+def ingest_multichip(path: str, label: Optional[str] = None) -> dict:
+    """Lift a MULTICHIP_r*.json dryrun capture into a history entry.
+
+    Multichip captures are *parity* records, not timings: each round's
+    driver runs the sharded engines against their unsharded twins and
+    reports bit-identity plus the largest machine validated. The entry
+    therefore carries no rep vector (``rep_times_s=[]`` — bench-diff
+    calls it incomparable, by design); its value is the max sharded
+    node count proven bit-identical, which is the dashboard's scaling
+    curve. The default label prefixes ``mc-`` so bench and multichip
+    rows in one history file stay distinguishable.
+    """
+    import re
+    with open(path) as f:
+        cap = json.load(f)
+    if not isinstance(cap, dict) or "n_devices" not in cap:
+        raise ValueError(f"{path}: not a MULTICHIP capture "
+                         "(no n_devices key)")
+    stem = os.path.splitext(os.path.basename(path))[0]
+    if label is None:
+        label = ("mc-" + stem[10:] if stem.startswith("MULTICHIP_")
+                 else "mc-" + stem)
+    tail = cap.get("tail", "") or ""
+    # the validated machine sizes appear as "<N>-node" or "<N> nodes"
+    # in the dryrun report lines; the largest one is the rung proven
+    nodes = [int(m) for m in re.findall(r"(\d+)[- ]nodes?\b", tail)]
+    if not nodes:
+        raise ValueError(f"{path}: no '<N> nodes' marker in tail — "
+                         "cannot place it on the scaling curve")
+    doc = {
+        "schema": SCHEMA_ID,
+        "label": str(label),
+        "source": os.path.basename(path),
+        "captured_at": None,
+        "git_sha": None,
+        "metric": "multichip sharded parity: max nodes bit-identical "
+                  "to unsharded",
+        "unit": "nodes",
+        "value": float(max(nodes)),
+        "vs_baseline": 0.0,
+        "config": {"kind": "multichip",
+                   "n_devices": int(cap.get("n_devices", 0)),
+                   "ok": bool(cap.get("ok", False)),
+                   "skipped": bool(cap.get("skipped", False))},
+        "rep_times_s": [],
+        "elapsed_s": None,
+        "steps": None,
+        "retired": None,
+        "quiescent": None,
+        "phases": None,
+        "device_kind": None,
+        "hlo_fingerprint": None,
+        "cost": None,
+    }
+    return validate_entry(doc)
 
 
 # lint: host

@@ -83,24 +83,14 @@ def capture(out_dir: Optional[str],
 # lint: host
 def _normalize_cost(cost) -> dict:
     """cost_analysis() shapes vary by backend/version: a dict, a list
-    of dicts (one per computation), or None. Collapse to one flat
-    {metric: float} dict, summing across computations."""
-    if cost is None:
-        return {}
-    if isinstance(cost, dict):
-        parts = [cost]
-    elif isinstance(cost, (list, tuple)):
-        parts = [c for c in cost if isinstance(c, dict)]
-    else:
-        return {}
-    out: dict = {}
-    for part in parts:
-        for k, v in part.items():
-            try:
-                out[str(k)] = out.get(str(k), 0.0) + float(v)
-            except (TypeError, ValueError):
-                continue
-    return out
+    of dicts (one per computation), or None/empty (the CPU backend
+    under JAX_PLATFORMS=cpu on some versions). Collapse to one flat
+    {metric: float} dict, summing across computations; unusable input
+    collapses to {} and callers mark it ``cost_unavailable`` instead
+    of KeyError-ing on a missing metric (obs.roofline owns the one
+    definition)."""
+    from ue22cs343bb1_openmp_assignment_tpu.obs import roofline
+    return roofline.normalize_cost(cost)
 
 
 # lint: host
@@ -115,7 +105,8 @@ def kernel_cost_report(jitted, *args, **kwargs) -> dict:
     backend supports neither: cost attribution is an instrument, not a
     dependency.
     """
-    rep = {"available": False, "cost": {}, "memory": {}}
+    rep = {"available": False, "cost": {}, "memory": {},
+           "cost_unavailable": True}
     try:
         compiled = jitted.lower(*args, **kwargs).compile()
     except Exception as e:
@@ -135,6 +126,10 @@ def kernel_cost_report(jitted, *args, **kwargs) -> dict:
     except Exception:
         pass
     rep["available"] = bool(rep["cost"] or rep["memory"])
+    # the explicit marker the roofline surfaces degrade on: an empty
+    # normalized cost dict means "this backend has no cost model", a
+    # state distinct from "zero bytes" (obs.roofline, ISSUE 7)
+    rep["cost_unavailable"] = not rep["cost"]
     return rep
 
 

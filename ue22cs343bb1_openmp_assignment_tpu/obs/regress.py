@@ -187,6 +187,26 @@ def compare(entry_a: dict, entry_b: dict,
             "b": {"label": entry_b.get("label")},
             "flags": ["engine_mismatch"],
         }
+    dev_a = entry_a.get("device_kind")
+    dev_b = entry_b.get("device_kind")
+    if dev_a and dev_b and dev_a != dev_b:
+        # times from different silicon never compare; refuse loudly
+        # rather than produce a numerically plausible wrong verdict
+        return {
+            "verdict": "incomparable",
+            "detail": (f"incomparable: different device "
+                       f"({dev_a!r} vs {dev_b!r})"),
+            "a": {"label": entry_a.get("label"), "device_kind": dev_a},
+            "b": {"label": entry_b.get("label"), "device_kind": dev_b},
+            "flags": ["device_mismatch"],
+        }
+    hlo_a = entry_a.get("hlo_fingerprint")
+    hlo_b = entry_b.get("hlo_fingerprint")
+    if hlo_a and hlo_b and hlo_a != hlo_b:
+        # informational, not fatal: comparing across code changes is
+        # the normal use of bench-diff, but the reader should know the
+        # compiled program is not the same one
+        flags.append("hlo_changed")
     for side, e in (("a", entry_a), ("b", entry_b)):
         if e.get("quiescent") is False:
             flags.append(f"not_quiescent:{side}")
@@ -247,6 +267,149 @@ def compare(entry_a: dict, entry_b: dict,
               "spread_pct": round(spread_b * 100.0, 3),
               "reps": len(reps_b)},
     }
+
+
+#: default tolerance for the exact bytes/instr gate — cost_analysis is
+#: deterministic per HLO, so this only absorbs benign layout churn
+#: (padding, fusion boundary shifts), not measurement noise
+DEFAULT_BYTES_TOL_PCT = 2.0
+
+
+# lint: host
+def compare_cost(entry_a: dict, entry_b: dict,
+                 tol_pct: float = DEFAULT_BYTES_TOL_PCT) -> dict:
+    """Exact bytes/instr comparison of two history entries' cost
+    vectors (A = baseline, B = candidate).
+
+    Unlike :func:`compare`, this needs no reps and no statistics: XLA's
+    ``cost_analysis()`` is deterministic per compiled HLO, so any
+    bytes/instr increase beyond ``tol_pct`` IS a regression — there is
+    no noise to hide behind. Returns a verdict doc::
+
+        {"verdict": "regression" | "improvement" | "pass"
+                    | "incomparable",
+         "delta_pct",                  # bytes/instr relative delta
+         "tol_pct",
+         "bytes_per_instr": {"a", "b"},
+         "offending_kernels": [{"name", "hbm_bytes_a", "hbm_bytes_b",
+                                "delta_pct"}, ...],  # worst first
+         "flags": [...], "a": {...}, "b": {...}}
+
+    Incomparable when either side lacks a usable cost vector (no
+    ``cost`` recorded, ``cost_available`` false, or bytes/instr
+    missing) or when the two entries come from different device kinds.
+    """
+    flags = []
+    dev_a = entry_a.get("device_kind")
+    dev_b = entry_b.get("device_kind")
+    if dev_a and dev_b and dev_a != dev_b:
+        return {
+            "verdict": "incomparable",
+            "detail": (f"incomparable: different device "
+                       f"({dev_a!r} vs {dev_b!r})"),
+            "a": {"label": entry_a.get("label"), "device_kind": dev_a},
+            "b": {"label": entry_b.get("label"), "device_kind": dev_b},
+            "flags": ["device_mismatch"],
+        }
+    cost_a = entry_a.get("cost")
+    cost_b = entry_b.get("cost")
+    for side, cost, e in (("a", cost_a, entry_a),
+                          ("b", cost_b, entry_b)):
+        if (not isinstance(cost, dict)
+                or not cost.get("cost_available", False)
+                or not isinstance(cost.get("bytes_per_instr"),
+                                  (int, float))):
+            return {
+                "verdict": "incomparable",
+                "detail": (f"no usable cost vector on side "
+                           f"{side} ({e.get('label')!r})"),
+                "a": {"label": entry_a.get("label")},
+                "b": {"label": entry_b.get("label")},
+                "flags": ["no_cost"],
+            }
+    hlo_a = entry_a.get("hlo_fingerprint")
+    hlo_b = entry_b.get("hlo_fingerprint")
+    if hlo_a and hlo_b and hlo_a != hlo_b:
+        flags.append("hlo_changed")
+    bpi_a = float(cost_a["bytes_per_instr"])
+    bpi_b = float(cost_b["bytes_per_instr"])
+    if bpi_a <= 0:
+        return {
+            "verdict": "incomparable",
+            "detail": "baseline bytes/instr is zero",
+            "a": {"label": entry_a.get("label")},
+            "b": {"label": entry_b.get("label")},
+            "flags": flags + ["no_cost"],
+        }
+    delta = (bpi_b - bpi_a) / bpi_a
+
+    # name the kernels that carry the increase, worst first
+    kerns_a = cost_a.get("kernels") or {}
+    kerns_b = cost_b.get("kernels") or {}
+    offending = []
+    for name in sorted(set(kerns_a) | set(kerns_b)):
+        ba = float((kerns_a.get(name) or {}).get("hbm_bytes", 0.0))
+        bb = float((kerns_b.get(name) or {}).get("hbm_bytes", 0.0))
+        if bb <= ba:
+            continue
+        kd = (bb - ba) / ba if ba > 0 else float("inf")
+        if kd * 100.0 > tol_pct:
+            offending.append({
+                "name": name,
+                "hbm_bytes_a": ba,
+                "hbm_bytes_b": bb,
+                "delta_pct": (round(kd * 100.0, 3)
+                              if math.isfinite(kd) else None),
+            })
+    offending.sort(
+        key=lambda o: -(o["hbm_bytes_b"] - o["hbm_bytes_a"]))
+
+    if delta * 100.0 > tol_pct:
+        verdict = "regression"
+    elif -delta * 100.0 > tol_pct:
+        verdict = "improvement"
+    else:
+        verdict = "pass"
+    return {
+        "verdict": verdict,
+        "delta_pct": round(delta * 100.0, 3),
+        "tol_pct": tol_pct,
+        "bytes_per_instr": {"a": bpi_a, "b": bpi_b},
+        "offending_kernels": offending,
+        "flags": flags,
+        "a": {"label": entry_a.get("label"),
+              "device_kind": dev_a,
+              "hlo_fingerprint": hlo_a},
+        "b": {"label": entry_b.get("label"),
+              "device_kind": dev_b,
+              "hlo_fingerprint": hlo_b},
+    }
+
+
+# lint: host
+def format_cost_report(rep: dict) -> str:
+    """Glanceable lines for the bytes gate (JSON is the machine
+    surface)."""
+    a, b = rep.get("a", {}), rep.get("b", {})
+    lines = [(f"bench-diff --bytes: {a.get('label', '?')} -> "
+              f"{b.get('label', '?')}: {rep['verdict'].upper()}")]
+    if rep["verdict"] == "incomparable":
+        lines.append(f"  {rep.get('detail', '')}")
+    else:
+        bpi = rep.get("bytes_per_instr", {})
+        lines.append(
+            f"  bytes/instr {bpi.get('a'):.4f} -> {bpi.get('b'):.4f} "
+            f"({rep['delta_pct']:+.2f}%, tolerance "
+            f"{rep['tol_pct']:.2f}%)")
+        for o in rep.get("offending_kernels", []):
+            d = (f"{o['delta_pct']:+.2f}%" if o["delta_pct"] is not None
+                 else "new traffic")
+            lines.append(
+                f"    kernel {o['name']}: {o['hbm_bytes_a']:.0f} -> "
+                f"{o['hbm_bytes_b']:.0f} HBM bytes/step ({d})")
+    if rep.get("flags"):
+        lines.append("  flags: " + ", ".join(rep["flags"]))
+    return "\n".join(lines)
 
 
 # lint: host

@@ -1,0 +1,392 @@
+"""Roofline + memory-traffic attribution: which kernel moves the bytes.
+
+ROADMAP item 1 (the fused Pallas cycle kernel) is a *memory-traffic*
+bet: a simulated cycle should never round-trip its state through HBM.
+Before this module nothing in ``obs/`` could say how many HBM bytes
+one simulated cycle moves, or which kernel moves them — so there was
+no instrument to pick the order of attack, and no way to prove a
+kernel change cut traffic rather than got lucky on timing noise.
+
+The model is Williams, Waterman & Patterson's roofline (CACM 2009,
+PAPERS.md): a kernel with arithmetic intensity ``AI = flops / HBM
+bytes`` below the machine's ridge point ``peak_flops / peak_bw`` is
+bound by memory bandwidth, not compute. The inputs come from XLA's
+``compiled.cost_analysis()`` (normalized by :func:`normalize_cost`
+from the dict/list/None shape variance) and a static per-device peak
+table (detected ``device_kind`` with a generic fallback), reduced to
+two headline scalars:
+
+- **bytes / simulated instruction** — per-step kernel HBM bytes ×
+  steps / instructions retired. Steps and retired are deterministic
+  integers of the run and the cost vector is deterministic per
+  compiled HLO, so this number is *exact*: it can gate CI with zero
+  reps and zero statistics (``cache-sim bench-diff --bytes``).
+- **ns / instruction by phase** — the wall-clock decomposition
+  (PhaseTimer dispatch/device_get split + the roofline model time per
+  kernel). Timing is nondeterministic, so it is opt-in
+  (``--timing``); the default report is byte-identical across runs on
+  the same build.
+
+Everything here is host-side: lowering and compiling never executes
+the computation.
+"""
+# lint: host
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+SCHEMA_ID = "cache-sim/perfreport/v1"
+
+#: cost_analysis() metric names for the three numbers a roofline needs
+_FLOPS_KEY = "flops"
+_BYTES_KEY = "bytes accessed"
+_OUT_BYTES_KEY = "bytes accessedout{}"
+
+#: static peak table: device_kind substring (lowercased, first match
+#: wins) -> nominal peak dense-compute flops/s and HBM bytes/s. These
+#: are ceilings for *classification*, not marketing claims — the bound
+#: verdict only needs the ridge point's order of magnitude. Sources:
+#: published TPU spec sheets; the cpu row is a nominal 1-core AVX box
+#: so CPU-tier smoke runs still classify.
+PEAKS = (
+    ("v6e", {"flops_per_s": 918e12, "hbm_bytes_per_s": 1.64e12}),
+    ("v5p", {"flops_per_s": 459e12, "hbm_bytes_per_s": 2.76e12}),
+    ("v5e", {"flops_per_s": 197e12, "hbm_bytes_per_s": 819e9}),
+    ("v5 lite", {"flops_per_s": 197e12, "hbm_bytes_per_s": 819e9}),
+    ("v4", {"flops_per_s": 275e12, "hbm_bytes_per_s": 1.2e12}),
+    ("cpu", {"flops_per_s": 1e11, "hbm_bytes_per_s": 4e10}),
+)
+
+#: unknown device kinds classify against this generic accelerator
+#: ceiling rather than failing — the report must degrade, not die
+_FALLBACK_PEAKS = {"flops_per_s": 2e14, "hbm_bytes_per_s": 1e12}
+
+
+# lint: host
+def detect_device_kind() -> str:
+    """``device_kind`` of the first attached device ("TPU v5e",
+    "cpu", ...); never raises."""
+    try:
+        import jax
+        d = jax.devices()[0]
+        return str(getattr(d, "device_kind", None) or d.platform)
+    except Exception:
+        return "unknown"
+
+
+# lint: host
+def device_peaks(kind: Optional[str] = None) -> dict:
+    """Peak specs for a device kind from the static table.
+
+    Returns ``{"kind", "flops_per_s", "hbm_bytes_per_s",
+    "ridge_flops_per_byte", "source"}`` — ``source`` is
+    ``"static_table"`` on a match, ``"generic_fallback"`` otherwise.
+    """
+    kind = detect_device_kind() if kind is None else str(kind)
+    low = kind.lower()
+    for sub, spec in PEAKS:
+        if sub in low:
+            peaks, source = spec, "static_table"
+            break
+    else:
+        peaks, source = _FALLBACK_PEAKS, "generic_fallback"
+    return {"kind": kind,
+            "flops_per_s": peaks["flops_per_s"],
+            "hbm_bytes_per_s": peaks["hbm_bytes_per_s"],
+            "ridge_flops_per_byte": (peaks["flops_per_s"]
+                                     / peaks["hbm_bytes_per_s"]),
+            "source": source}
+
+
+# lint: host
+def normalize_cost(cost) -> dict:
+    """Collapse ``cost_analysis()``'s shape variance to one flat
+    ``{metric: float}`` dict.
+
+    Backends return a dict, a list of per-computation dicts, ``None``,
+    or an empty list (the CPU backend under some versions); anything
+    unusable collapses to ``{}`` — callers mark that as
+    ``cost_unavailable`` rather than KeyError-ing (the tier-1
+    degradation path).
+    """
+    if cost is None:
+        return {}
+    if isinstance(cost, dict):
+        parts = [cost]
+    elif isinstance(cost, (list, tuple)):
+        parts = [c for c in cost if isinstance(c, dict)]
+    else:
+        return {}
+    out: dict = {}
+    for part in parts:
+        for k, v in part.items():
+            try:
+                out[str(k)] = out.get(str(k), 0.0) + float(v)
+            except (TypeError, ValueError):
+                continue
+    return out
+
+
+# lint: host
+def hlo_fingerprint(text: str) -> str:
+    """Stable 16-hex-digit fingerprint of a lowered program's text —
+    the comparability key recorded in bench history: two entries with
+    the same fingerprint ran the same compiled program."""
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()[:16]
+
+
+# lint: host
+def kernel_record(name: str, jitted, *args, **kwargs) -> dict:
+    """Lower + compile one jitted callable and extract its roofline
+    inputs: ``{name, flops, hbm_bytes, output_bytes, cost_available,
+    hlo_fingerprint, error?}``.
+
+    ``cost_available=False`` (with the numbers at ``None``) when the
+    backend returns no usable cost model — the explicit
+    ``cost_unavailable`` marker the CLI degrades on. Lowering compiles
+    but never executes.
+    """
+    rec = {"name": str(name), "flops": None, "hbm_bytes": None,
+           "output_bytes": None, "cost_available": False,
+           "hlo_fingerprint": None}
+    try:
+        lowered = jitted.lower(*args, **kwargs)
+        rec["hlo_fingerprint"] = hlo_fingerprint(lowered.as_text())
+        compiled = lowered.compile()
+    except Exception as e:
+        rec["error"] = str(e)
+        return rec
+    try:
+        cost = normalize_cost(compiled.cost_analysis())
+    except Exception:
+        cost = {}
+    if _BYTES_KEY in cost or _FLOPS_KEY in cost:
+        rec["flops"] = float(cost.get(_FLOPS_KEY, 0.0))
+        rec["hbm_bytes"] = float(cost.get(_BYTES_KEY, 0.0))
+        rec["output_bytes"] = float(cost.get(_OUT_BYTES_KEY, 0.0))
+        rec["cost_available"] = True
+    return rec
+
+
+# lint: host
+def classify(rec: dict, peaks: dict) -> dict:
+    """Fold device peaks into a kernel record: arithmetic intensity,
+    attainable ceiling fraction, model step time, and the bound
+    verdict.
+
+    - ``arith_intensity`` = flops / HBM bytes (flops per byte).
+    - ``bound`` = ``"hbm"`` when AI < ridge point (bandwidth is the
+      roof), ``"compute"`` otherwise, ``"cost_unavailable"`` when the
+      backend has no cost model.
+    - ``ceiling_frac`` = min(1, AI / ridge): the fraction of peak
+      compute the roofline permits at this intensity — how far under
+      the compute roof the bandwidth roof sits.
+    - ``model_time_s`` = max(bytes/bw, flops/peak): the best case for
+      one invocation; measured time far above it means dispatch/host
+      overhead, not the device (the --timing dispatch check).
+
+    Deterministic: pure arithmetic on the deterministic cost vector.
+    """
+    out = dict(rec)
+    if not rec.get("cost_available"):
+        out.update(arith_intensity=None, ceiling_frac=None,
+                   model_time_s=None, bound="cost_unavailable")
+        return out
+    flops = rec["flops"] or 0.0
+    hbm = rec["hbm_bytes"] or 0.0
+    ridge = peaks["ridge_flops_per_byte"]
+    ai = (flops / hbm) if hbm > 0 else float("inf")
+    ceiling = min(1.0, ai / ridge) if ridge > 0 else 1.0
+    model_t = max(hbm / peaks["hbm_bytes_per_s"],
+                  flops / peaks["flops_per_s"])
+    out.update(arith_intensity=round(ai, 6),
+               ceiling_frac=round(ceiling, 6),
+               model_time_s=model_t,
+               bound="hbm" if ai < ridge else "compute")
+    return out
+
+
+# lint: host
+def cost_vector(per_step: dict, runner: Optional[dict],
+                steps: int, retired: int) -> dict:
+    """The deterministic cost vector recorded into bench history.
+
+    ``per_step`` is the kernel_record of the engine's one-step kernel
+    (cycle / round), ``runner`` the whole quiescence runner (XLA
+    counts a while body once, so its cost ≈ one chunk). bytes/instr =
+    per-step HBM bytes × steps / retired — exact for a fixed build,
+    the number the ``--bytes`` gate compares.
+    """
+    kernels = {}
+    for rec in (per_step, runner):
+        if rec is not None:
+            kernels[rec["name"]] = {
+                "flops": rec["flops"], "hbm_bytes": rec["hbm_bytes"],
+                "output_bytes": rec["output_bytes"],
+                "cost_available": bool(rec["cost_available"]),
+            }
+    avail = bool(per_step.get("cost_available")) and retired > 0
+    bpi = fpi = None
+    if avail:
+        bpi = per_step["hbm_bytes"] * steps / retired
+        fpi = per_step["flops"] * steps / retired
+    return {"per_step_kernel": per_step["name"],
+            "steps": int(steps), "retired": int(retired),
+            "bytes_per_instr": (round(bpi, 6) if bpi is not None
+                                else None),
+            "flops_per_instr": (round(fpi, 6) if fpi is not None
+                                else None),
+            "cost_available": avail,
+            "kernels": kernels}
+
+
+# lint: host
+def build_report(engine: str, config: dict, records: list,
+                 per_step_name: str, steps: int, retired: int,
+                 device_kind: Optional[str] = None,
+                 timing: Optional[dict] = None) -> dict:
+    """Assemble the ``cache-sim/perfreport/v1`` document.
+
+    ``records`` are kernel_records (the per-step kernel named by
+    ``per_step_name`` must be among them); classification, traffic
+    totals and the headline bytes/instr are computed here. ``timing``
+    (nondeterministic) is attached verbatim only when given — the
+    default document is deterministic per build.
+    """
+    peaks = device_peaks(device_kind)
+    kernels = [classify(r, peaks) for r in records]
+    for k in kernels:
+        k["per_step"] = (k["name"] == per_step_name)
+    # HBM traffic ranking: the "which kernel moves the bytes" order
+    kernels.sort(key=lambda k: (-(k["hbm_bytes"] or 0.0), k["name"]))
+    per_step = next((k for k in kernels if k["name"] == per_step_name),
+                    None)
+    if per_step is None:
+        raise ValueError(f"per-step kernel {per_step_name!r} not in "
+                         f"records {[k['name'] for k in kernels]}")
+    vec = cost_vector(per_step, None, steps, retired)
+    avail = [k for k in kernels if k["cost_available"]]
+    top = avail[0] if avail else None
+    doc = {
+        "schema": SCHEMA_ID,
+        "engine": engine,
+        "config": dict(config),
+        "device": peaks,
+        "steps": int(steps),
+        "retired": int(retired),
+        "cost_available": vec["cost_available"],
+        "bytes_per_instr": vec["bytes_per_instr"],
+        "flops_per_instr": vec["flops_per_instr"],
+        "per_step_kernel": per_step_name,
+        "bound": per_step["bound"],
+        "top_hbm_kernel": (top["name"] if top else None),
+        "kernels": kernels,
+    }
+    if timing is not None:
+        doc["timing"] = timing
+    return doc
+
+
+# lint: host
+def timing_section(phases: dict, kernels: list, steps: int,
+                   retired: int, rep_times_s: list) -> dict:
+    """The opt-in nondeterministic half: ns/instr decomposed by phase
+    and (via the roofline model) by kernel.
+
+    ``by_phase`` splits the measured median rep into the PhaseTimer
+    buckets (execute dispatch vs device_get sync); ``by_kernel``
+    attributes the model's share — per-step model time × steps — so a
+    measured/model ratio far above 1 reads as dispatch-bound: the
+    device is idle waiting on the host, and no amount of kernel diet
+    fixes that (PERF.md's ~0.1 s fixed dispatch tax).
+    """
+    med = sorted(rep_times_s)[len(rep_times_s) // 2] if rep_times_s \
+        else None
+    out = {"rep_times_s": [round(t, 6) for t in rep_times_s],
+           "ns_per_instr": None, "by_phase": {}, "by_kernel": {},
+           "dispatch_bound": None}
+    if med is None or retired <= 0:
+        return out
+    out["ns_per_instr"] = round(med / retired * 1e9, 3)
+    ph = (phases or {}).get("phases", {})
+    reps = max(1, len(rep_times_s))
+    for name in ("execute_dispatch", "device_get_sync"):
+        if name in ph:
+            out["by_phase"][name] = round(
+                ph[name]["seconds"] / reps / retired * 1e9, 3)
+    model_total = 0.0
+    for k in kernels:
+        if k.get("model_time_s") is not None:
+            t = k["model_time_s"] * (steps if k.get("per_step") else 1)
+            out["by_kernel"][k["name"]] = round(t / retired * 1e9, 3)
+            model_total = max(model_total, t)
+    if model_total > 0:
+        # the dispatch check: measured time >> roofline best case
+        # means the host/dispatch path, not the device, is the bound
+        out["measured_over_model"] = round(med / model_total, 2)
+        out["dispatch_bound"] = bool(med > 10.0 * model_total)
+    return out
+
+
+_BOUND_TEXT = {"hbm": "HBM-bound", "compute": "compute-bound",
+               "cost_unavailable": "cost unavailable"}
+
+
+# lint: host
+def render_text(doc: dict) -> str:
+    """The one-screen answer to "where does the 5x go"."""
+    dev = doc["device"]
+    lines = [
+        f"perf-report: {doc['engine']} engine, "
+        f"{doc['config'].get('nodes', '?')} nodes "
+        f"({dev['kind']}, peaks {dev['flops_per_s']:.3g} flop/s / "
+        f"{dev['hbm_bytes_per_s']:.3g} B/s, "
+        f"ridge {dev['ridge_flops_per_byte']:.2f} flop/B, "
+        f"{dev['source']})",
+        f"  steps={doc['steps']} retired={doc['retired']} "
+        f"per-step kernel={doc['per_step_kernel']}",
+    ]
+    if doc["cost_available"]:
+        lines.append(
+            f"  bytes/instr = {doc['bytes_per_instr']:.2f}   "
+            f"flops/instr = {doc['flops_per_instr']:.2f}   "
+            f"bound: {_BOUND_TEXT[doc['bound']]}")
+        lines.append(
+            f"  top HBM-traffic kernel: {doc['top_hbm_kernel']}")
+    else:
+        lines.append("  cost model unavailable on this backend "
+                     "(cost_unavailable); traffic attribution "
+                     "degrades to kernel names only")
+    lines.append("")
+    lines.append(f"  {'kernel':<28} {'flops':>12} {'HBM bytes':>12} "
+                 f"{'AI f/B':>8} {'ceil%':>6}  bound")
+    for k in doc["kernels"]:
+        if k["cost_available"]:
+            lines.append(
+                f"  {k['name']:<28} {k['flops']:>12.0f} "
+                f"{k['hbm_bytes']:>12.0f} "
+                f"{k['arith_intensity']:>8.3f} "
+                f"{100 * k['ceiling_frac']:>5.1f}%  "
+                f"{_BOUND_TEXT[k['bound']]}")
+        else:
+            why = k.get("error", "cost_unavailable")
+            lines.append(f"  {k['name']:<28} -- {why}")
+    t = doc.get("timing")
+    if t:
+        lines.append("")
+        lines.append(f"  timing (nondeterministic): ns/instr = "
+                     f"{t['ns_per_instr']}")
+        for name, v in t["by_phase"].items():
+            lines.append(f"    {name:<22} {v:>10} ns/instr")
+        for name, v in t["by_kernel"].items():
+            lines.append(f"    model:{name:<16} {v:>10} ns/instr")
+        if t.get("dispatch_bound") is not None:
+            lines.append(
+                f"    measured/model = {t.get('measured_over_model')}"
+                + ("  => DISPATCH-BOUND (host overhead dominates; "
+                   "kernel diet won't move the headline)"
+                   if t["dispatch_bound"] else
+                   "  (device-bound regime)"))
+    return "\n".join(lines) + "\n"
