@@ -119,6 +119,17 @@ def main():
                          "Default: on when a TPU backend is attached "
                          "(+19%% measured); off elsewhere (the CPU "
                          "interpreter is impractically slow)")
+    ap.add_argument("--fused-round", choices=["auto", "on", "off"],
+                    default="auto",
+                    help="deep engine: execute the ENTIRE round as one "
+                         "fused Pallas kernel with directory/cache/slot "
+                         "state resident in VMEM (ops.pallas_round; "
+                         "bit-identical to the XLA reference path, "
+                         "tests/test_pallas_round.py). auto: on when a "
+                         "TPU backend is attached and the config is "
+                         "supported (no --read-storm, deep_slots*nodes "
+                         "under the scatter-min margin); off: always "
+                         "the XLA reference path")
     ap.add_argument("--sharded", action="store_true",
                     help="shard the simulated-node axis over ALL "
                          "attached devices (jax.sharding.Mesh + "
@@ -238,6 +249,27 @@ def main():
     elif args.pallas:
         print("note: --pallas applies only to the sync-family engines; "
               "measuring without the Pallas kernels", file=sys.stderr)
+    if args.engine == "deep":
+        import dataclasses
+        from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_round
+        ok = pallas_round.supported(cfg)
+        on_tpu = jax.default_backend() == "tpu"
+        want = (args.fused_round == "on"
+                or (args.fused_round == "auto" and on_tpu and ok))
+        if args.fused_round == "on" and not ok:
+            print("note: --fused-round=on needs a supported config (no "
+                  "--read-storm, deep_slots*nodes < 16384); measuring "
+                  "the XLA reference path instead", file=sys.stderr)
+            want = False
+        if want and not on_tpu:
+            print("note: --fused-round on a non-TPU backend runs the "
+                  "Pallas interpreter (very slow; parity checking "
+                  "only)", file=sys.stderr)
+        if want:
+            cfg = dataclasses.replace(cfg, fused_round=True)
+    elif args.fused_round == "on":
+        print("note: --fused-round applies only to the deep engine; "
+              "measuring without it", file=sys.stderr)
     gen_kw = {"local_frac": args.local_frac} if args.workload == "uniform" else {}
 
     def make_system(seed):

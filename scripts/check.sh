@@ -125,6 +125,56 @@ if [[ "$rc" != 4 ]]; then
     exit 1
 fi
 
+# Fused-round smoke (30s box): the fused Pallas round kernel's routed
+# index ops must stay bit-exact against the XLA gather/scatter they
+# replace (same contract the slow-tier round-parity tests check end to
+# end), and the kernel's io-contract traffic at the recorded deep@4096
+# headline must stay strictly below the unfused XLA cost-model
+# bytes/instr (PERF.md: 191377.95) — the bench-diff bytes gate's
+# question, answered from the kernel's own I/O contract.
+timeout -k 5 30 env JAX_PLATFORMS=cpu python - <<'PYEOF'
+import dataclasses
+import numpy as np
+import jax.numpy as jnp
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.ops import deep_engine as de
+from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_round as pr
+
+cfg = dataclasses.replace(
+    SystemConfig.scale(num_nodes=8, drain_depth=2, txn_width=2),
+    deep_window=True, deep_slots=4, deep_ownerval_slots=2)
+ix, nat = pr.RoutedIndexOps(cfg, 3), de.XlaIndexOps()
+rng = np.random.default_rng(7)
+M, K, R = 96, 5, 64
+mat = jnp.asarray(rng.integers(-2**31, 2**31, (M, K)).astype(np.int32))
+gidx = jnp.asarray(rng.integers(0, M, R).astype(np.int32))
+sidx = jnp.asarray(np.where(rng.random(R) < 0.3, M,
+                            rng.permutation(M)[:R]).astype(np.int32))
+rows = jnp.asarray(rng.integers(-2**31, 2**31, (R, K)).astype(np.int32))
+for a, b in [(ix.gather(mat[:, 0], gidx), nat.gather(mat[:, 0], gidx)),
+             (ix.gather_rows(mat, gidx), nat.gather_rows(mat, gidx)),
+             (ix.scatter_rows(mat, sidx, rows),
+              nat.scatter_rows(mat, sidx, rows)),
+             (ix.scatter_col(mat, sidx, 2, rows[:, 0]),
+              nat.scatter_col(mat, sidx, 2, rows[:, 0]))]:
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+vals = (ix._cd << ix._L) | jnp.asarray(
+    rng.integers(0, 1 << ix._L, R).astype(np.int32))
+dest = jnp.full((M,), np.iinfo(np.int32).max, dtype=jnp.int32)
+np.testing.assert_array_equal(
+    np.asarray(ix.scatter_min(dest, sidx, vals)),
+    np.asarray(nat.scatter_min(dest, sidx, vals)))
+hl = dataclasses.replace(
+    SystemConfig.scale(num_nodes=4096, drain_depth=13, txn_width=3),
+    deep_window=True, deep_slots=3, deep_ownerval_slots=1)
+assert pr.supported(hl)
+io_in, io_out = pr.io_contract_bytes(hl)
+bpi = (io_in + io_out) * 64 / 131072
+assert bpi < 191377.95, bpi
+print(f"fused-round smoke: ok (routed ops exact, io-contract "
+      f"{bpi:.1f} B/instr < xla 191377.95)")
+PYEOF
+
 if [[ "${1:-}" == "--analyze" ]]; then
     exit 0
 fi

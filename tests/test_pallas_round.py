@@ -1,0 +1,273 @@
+"""Fused Pallas round kernel (ops/pallas_round) vs the XLA path.
+
+Two layers of bit-parity, mirroring the module's design:
+
+1. **Routed index ops** (fast tier): `RoutedIndexOps` is plain jnp, so
+   its one-hot-matmul gathers/scatters and the chunked exponent
+   scatter-min are pinned against `deep_engine.XlaIndexOps` on random
+   data directly — including the 2**14-contender rounding margin the
+   `supported` gate enforces — without paying a Pallas trace or an
+   engine compile.
+2. **Engine rounds** (slow tier): the full round through
+   `deep_round_core` with routed ops, and through the fused kernel in
+   interpret mode, must equal `round_step_deep` leaf-for-leaf on
+   warmed machines (the tests/test_pallas_deep.py pattern: tiny
+   machine on CPU, full size validated on a TPU backend).
+
+The io-contract arithmetic (the perf-report comparison row) is pinned
+against the recorded headline numbers: 64 rounds / 131072 retired at
+deep@4096 put the fused kernel at 2480.00 bytes/instr vs the measured
+191377.95 on the unfused path (PERF.md).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.obs import roofline
+from ue22cs343bb1_openmp_assignment_tpu.ops import deep_engine as de
+from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_round as pr
+from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+
+
+def _cfg(**kw):
+    local = kw.pop("local", 200)
+    cfg = SystemConfig.scale(num_nodes=8, drain_depth=2, txn_width=2)
+    return dataclasses.replace(
+        cfg, procedural="uniform", max_instrs=1, deep_window=True,
+        deep_slots=4, deep_ownerval_slots=2,
+        proc_local_permille=local, **kw)
+
+
+def _assert_states_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------- fast:
+# routed index ops vs the native ops on raw data
+
+
+def test_routed_gather_scatter_match_native():
+    """One-hot matmul routing is exact on int32 payloads — including
+    negative values (owner -1 round-trips the 16-bit halves) and the
+    one-past-the-end drop sentinel."""
+    rng = np.random.default_rng(7)
+    M, K, R = 96, 7, 64
+    mat = jnp.asarray(
+        rng.integers(-(2 ** 31), 2 ** 31, (M, K), dtype=np.int64)
+        .astype(np.int32))
+    idx = jnp.asarray(rng.integers(0, M, (4, R // 4), dtype=np.int64)
+                      .astype(np.int32))
+    nat, rt = de.XlaIndexOps(), pr.RoutedIndexOps(_cfg(), 3)
+    np.testing.assert_array_equal(
+        np.asarray(rt.gather_rows(mat, idx)),
+        np.asarray(nat.gather_rows(mat, idx)))
+    np.testing.assert_array_equal(
+        np.asarray(rt.gather(mat[:, 0], idx)),
+        np.asarray(nat.gather(mat[:, 0], idx)))
+    # scatter: unique in-range indices + dropped sentinels
+    perm = rng.permutation(M)[:R].astype(np.int32)
+    sidx = jnp.asarray(np.where(rng.random(R) < 0.3, M, perm))
+    rows = jnp.asarray(
+        rng.integers(-(2 ** 31), 2 ** 31, (R, K), dtype=np.int64)
+        .astype(np.int32))
+    np.testing.assert_array_equal(
+        np.asarray(rt.scatter_rows(mat, sidx, rows)),
+        np.asarray(nat.scatter_rows(mat, sidx, rows)))
+    np.testing.assert_array_equal(
+        np.asarray(rt.scatter_col(mat, sidx, 2, rows[:, 2])),
+        np.asarray(nat.scatter_col(mat, sidx, 2, rows[:, 2])))
+
+
+def test_routed_scatter_min_exact_including_margin():
+    """The chunked exponent scatter-min is exact at the supported
+    cap: 2**14 contenders piled on single entries, adversarial chunk
+    patterns (all-equal, one-below-the-crowd), and drop sentinels."""
+    cfg = _cfg()
+    round_ = 5
+    ix = pr.RoutedIndexOps(cfg, round_)
+    nat = de.XlaIndexOps()
+    L, cd = ix._L, int(ix._cd)
+    rng = np.random.default_rng(11)
+    M, R = 128, 1 << 14
+    dest = jnp.asarray(
+        rng.integers((cd + 1) << L, 2 ** 30, M, dtype=np.int64)
+        .astype(np.int32))
+    low = rng.integers(0, 1 << L, R, dtype=np.int64).astype(np.int32)
+    # adversarial rows: entry 0 takes ALL contenders of one chunk value
+    # but one (the threshold-count boundary); entry 1 takes all-equal
+    idx = rng.integers(0, M, R, dtype=np.int64).astype(np.int32)
+    idx[: R // 2] = 0
+    low[: R // 2] = (1 << L) - 1
+    low[0] = 1
+    idx[R // 2: 3 * R // 4] = 1
+    low[R // 2: 3 * R // 4] = (1 << L) // 2
+    idx[-8:] = M          # dropped
+    vals = jnp.asarray((cd << L) | low)
+    idx = jnp.asarray(idx)
+    np.testing.assert_array_equal(
+        np.asarray(ix.scatter_min(dest, idx, vals)),
+        np.asarray(nat.scatter_min(dest, idx, vals)))
+    # the wave variant: INT_MAX-filled destination
+    full = jnp.full((M,), 2 ** 31 - 1, jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(ix.scatter_min(full, idx, vals)),
+        np.asarray(nat.scatter_min(full, idx, vals)))
+
+
+def test_supported_gate():
+    cfg = _cfg()
+    assert pr.supported(cfg)
+    assert not pr.supported(
+        dataclasses.replace(cfg, deep_read_storm=True))
+    assert not pr.supported(dataclasses.replace(cfg, deep_window=False))
+    # the scatter-min rounding margin: deep_slots * nodes < 2**14
+    big = SystemConfig.scale(num_nodes=8192, drain_depth=2,
+                             txn_width=2)
+    big = dataclasses.replace(big, deep_window=True, deep_slots=3)
+    assert not pr.supported(big)
+    assert pr.supported(dataclasses.replace(big, deep_slots=1))
+
+
+def test_io_contract_bytes_pinned_headline():
+    """The fused kernel's per-round HBM contract at the perf-report
+    deep@4096 config, against the recorded unfused measurement
+    (PERF.md: 64 rounds retire 131072; bytes/instr 191377.95)."""
+    cfg = SystemConfig.scale(num_nodes=4096, drain_depth=13,
+                             txn_width=3)
+    cfg = dataclasses.replace(cfg, deep_window=True, deep_slots=3,
+                              deep_ownerval_slots=1)
+    assert pr.supported(cfg)
+    io_in, io_out = pr.io_contract_bytes(cfg)
+    assert io_in + io_out == 5_079_040        # ~4.8 MB/round
+    fused_bpi = (io_in + io_out) * 64 / 131072
+    assert fused_bpi == 2480.0
+    assert fused_bpi < 191377.95              # the ISSUE 8 gate
+
+
+def test_io_contract_report_row_and_render():
+    """io-contract records ride build_report as ordinary kernel rows
+    (labeled by basis), and the fused comparison section renders."""
+    per = {"name": "sync.round_step", "flops": 4e8, "hbm_bytes": 4e8,
+           "output_bytes": 1e8, "cost_available": True,
+           "hlo_fingerprint": "ab"}
+    fused = roofline.io_contract_record("deep.round_fused[io-contract]",
+                                        2_867_200, 2_211_840)
+    assert fused["basis"] == "io-contract"
+    doc = roofline.build_report(
+        "deep", {"nodes": 4096}, [per, fused], "sync.round_step",
+        64, 131072, device_kind="cpu")
+    row = next(k for k in doc["kernels"]
+               if k.get("basis") == "io-contract")
+    assert row["cost_available"] and row["hbm_bytes"] == 5_079_040
+    doc["fused"] = {"kernel": row["name"], "basis": "io-contract",
+                    "bytes_per_instr": 2480.0,
+                    "unfused_bytes_per_instr": doc["bytes_per_instr"]}
+    text = roofline.render_text(doc)
+    assert "io-contract" in text and "2480.00" in text
+
+
+# ---------------------------------------------------------------- slow:
+# full engine rounds (CPU interpreter; tiny machine, the
+# tests/test_pallas_deep.py pattern)
+
+
+@pytest.mark.slow  # >60 s single-CPU (deep compile + eager routing)
+def test_routed_round_bit_identical_mid_run():
+    """round_step_deep with RoutedIndexOps injected — the fused
+    kernel's routing math through the REAL shared middle — equals the
+    native path leaf-for-leaf on a warmed, contended machine."""
+    cfg = _cfg()
+    st = se.procedural_state(cfg, 200, seed=1)
+    st = se.run_rounds(cfg, st, 30)
+    for _ in range(2):
+        a = de.round_step_deep(cfg, st)
+        b = de.round_step_deep(
+            cfg, st, index_ops=pr.RoutedIndexOps(cfg, st.round))
+        _assert_states_equal(a, b)
+        st = a
+
+
+@pytest.mark.slow  # >60 s single-CPU
+def test_routed_round_bit_identical_waves():
+    """Absorption waves route extra scatter-min/gather pairs per wave
+    through the strategy; parity must hold there too."""
+    cfg = dataclasses.replace(_cfg(), deep_waves=3)
+    st = se.procedural_state(cfg, 200, seed=9)
+    st = se.run_rounds(cfg, st, 30)
+    a = de.round_step_deep(cfg, st)
+    b = de.round_step_deep(cfg, st,
+                           index_ops=pr.RoutedIndexOps(cfg, st.round))
+    _assert_states_equal(a, b)
+
+
+@pytest.mark.slow  # >120 s single-CPU (whole-round kernel, interpreter)
+def test_fused_round_bit_identical_mid_run():
+    """The tentpole contract: the single fused kernel (interpret mode
+    on CPU) reproduces round_step_deep bit-for-bit, and round_step
+    dispatches to it under cfg.fused_round."""
+    cfg = _cfg()
+    fcfg = dataclasses.replace(cfg, fused_round=True)
+    st = se.procedural_state(cfg, 200, seed=1)
+    st = se.run_rounds(cfg, st, 30)
+    a = de.round_step_deep(cfg, st)
+    b = pr.round_step_deep_fused(cfg, st)
+    c = se.round_step(fcfg, st)
+    _assert_states_equal(a, b)
+    _assert_states_equal(a, c)
+    se.check_exact_directory(cfg, b)
+
+
+@pytest.mark.slow  # >90 s single-CPU
+def test_fused_round_stored_trace():
+    """Stored-trace windows (the non-procedural gather build) feed the
+    same kernel — the window is built in XLA either way."""
+    from ue22cs343bb1_openmp_assignment_tpu.models.system import (
+        CoherenceSystem)
+    cfg = dataclasses.replace(_cfg(), procedural=None, max_instrs=64)
+    sys_ = CoherenceSystem.from_workload(cfg, "uniform", trace_len=48,
+                                         seed=3, local_frac=0.3)
+    st = se.from_sim_state(cfg, sys_.state, seed=1)
+    st = se.run_rounds(cfg, st, 6)
+    a = de.round_step_deep(cfg, st)
+    b = pr.round_step_deep_fused(cfg, st)
+    _assert_states_equal(a, b)
+
+
+@pytest.mark.slow  # >120 s single-CPU (two protocol variants)
+@pytest.mark.parametrize("protocol", ["moesi", "mesif"])
+def test_fused_round_protocol_variants(protocol):
+    """Protocol-variant configs (MOESI/MESIF state ranges) run the
+    fused kernel bit-identically — cold start, so first fills and
+    promotions happen under the variant config."""
+    cfg = dataclasses.replace(_cfg(local=500), protocol=protocol)
+    st = se.procedural_state(cfg, 64, seed=4)
+    st = se.run_rounds(cfg, st, 4)
+    a = de.round_step_deep(cfg, st)
+    b = pr.round_step_deep_fused(cfg, st)
+    _assert_states_equal(a, b)
+
+
+@pytest.mark.slow  # >120 s single-CPU (8-device dryrun + interpreter)
+def test_fused_round_matches_sharded_reference():
+    """Sharded 8-device dryrun parity: the single-device fused round
+    equals the 8-way sharded XLA deep round (conftest forces
+    xla_force_host_platform_device_count=8)."""
+    from ue22cs343bb1_openmp_assignment_tpu.parallel import (
+        make_mesh, make_sharded_round, shard_state)
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU dryrun")
+    cfg = _cfg(local=500)
+    st = se.procedural_state(cfg, 64, seed=2)
+    st = se.run_rounds(cfg, st, 6)
+    mesh = make_mesh(jax.devices()[:8])
+    sharded = shard_state(cfg, mesh, st)
+    ref = make_sharded_round(cfg, mesh, sharded)(sharded)
+    out = pr.round_step_deep_fused(cfg, st)
+    _assert_states_equal(jax.device_get(ref), jax.device_get(out))

@@ -97,7 +97,36 @@ def _targets(cfg: SystemConfig) -> dict:
         "step.run_cycles[8]": lambda s: step.run_cycles(cfg, s, 8),
         "step.run_to_quiescence":
             lambda s: step.run_to_quiescence(cfg, s, 64),
+        "pallas_round.routed_ops": lambda s: _routed_ops_probe(),
     }
+
+
+def _routed_ops_probe():
+    """Exercise every routed index op the fused round kernel substitutes
+    for XLA gather/scatter (ops/pallas_round.RoutedIndexOps) at small
+    shapes, so the IR audit covers the new kernel's only non-dense
+    machinery: one-hot matmul routing and the chunked scatter-min
+    ladder.  Shapes are tiny but structurally identical to the kernel's
+    (the fori_loop tiling and the 16-way chunk ladder trace the same
+    primitives at any size)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_round as pr
+
+    cfg = dataclasses.replace(
+        SystemConfig.scale(num_nodes=8, drain_depth=2, txn_width=2),
+        deep_window=True, deep_slots=4, deep_ownerval_slots=2)
+    ix = pr.RoutedIndexOps(cfg, 3)
+    mat = jnp.arange(64 * 5, dtype=jnp.int32).reshape(64, 5)
+    idx = jnp.arange(16, dtype=jnp.int32) * 3
+    rows = jnp.arange(16 * 5, dtype=jnp.int32).reshape(16, 5) - 40
+    dest = jnp.full((64,), 2**30, dtype=jnp.int32)
+    return (ix.gather(mat[:, 0], idx), ix.gather_rows(mat, idx),
+            ix.scatter_rows(mat, idx, rows),
+            ix.scatter_col(mat, idx, 2, rows[:, 0]),
+            ix.scatter_min(dest, idx, rows[:, 0] + 41))
 
 
 def lint(cfg: Optional[SystemConfig] = None,

@@ -149,6 +149,18 @@ class SystemConfig:
     # it on automatically when a TPU backend is attached.
     pallas_burst: bool = False
 
+    # Execute the ENTIRE deep-engine round as one fused Pallas kernel
+    # (ops.pallas_round): window folds, arbitration, handler effects
+    # and fan-out in a single pallas_call with directory/cache/slot
+    # state resident in VMEM, index ops routed through exact one-hot
+    # MXU matmuls. Bit-identical to the XLA path on supported configs
+    # (pallas_round.supported — no read-storm, deep_slots * num_nodes
+    # under the scatter-min margin); round_step falls back to the XLA
+    # reference path otherwise. OFF by default for the same reason as
+    # pallas_burst (CPU fallback is the interpreter); bench.py exposes
+    # it as --fused-round.
+    fused_round: bool = False
+
     # Coherence protocol variant. 'mesi' is the reference protocol and
     # the only one the hand-written ops/handlers.py implements; 'moesi'
     # and 'mesif' are expressed as declarative tables

@@ -802,7 +802,7 @@ def cmd_perfreport(args) -> int:
         per_step_name = "sync.round_step"
 
         def records():
-            return [
+            recs = [
                 roofline.kernel_record(
                     per_step_name,
                     jax.jit(lambda s: se.round_step(cfg, s)), st0),
@@ -810,6 +810,19 @@ def cmd_perfreport(args) -> int:
                     f"sync.run_to_quiescence[chunk={chunk}]",
                     se._run_sync_jit, cfg, st0, chunk, max_cycles),
             ]
+            if args.engine == "deep":
+                # the fused-vs-unfused comparison row: the fused round
+                # kernel's HBM traffic is its I/O contract (state is
+                # VMEM-resident; XLA's cost model can't see through
+                # the pallas_call custom call), labeled io-contract vs
+                # the xla-cost-model rows above
+                from ue22cs343bb1_openmp_assignment_tpu.ops import (
+                    pallas_round)
+                if pallas_round.supported(cfg):
+                    io_in, io_out = pallas_round.io_contract_bytes(cfg)
+                    recs.append(roofline.io_contract_record(
+                        "deep.round_fused[io-contract]", io_in, io_out))
+            return recs
     else:
         st0 = system.state
 
@@ -853,6 +866,15 @@ def cmd_perfreport(args) -> int:
          "pallas": bool(getattr(cfg, "pallas_burst", False))},
         records(), per_step_name, steps, retired,
         device_kind=args.device_kind)
+    fused = next((k for k in doc["kernels"]
+                  if k.get("basis") == "io-contract"), None)
+    if fused is not None and doc["cost_available"]:
+        doc["fused"] = {
+            "kernel": fused["name"], "basis": "io-contract",
+            "bytes_per_instr": round(
+                fused["hbm_bytes"] * steps / retired, 6),
+            "unfused_bytes_per_instr": doc["bytes_per_instr"],
+        }
     if args.timing:
         timer = PhaseTimer()
         rep_times = []

@@ -156,7 +156,7 @@ from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState, Op
 from ue22cs343bb1_openmp_assignment_tpu.ops import deep_fold
 from ue22cs343bb1_openmp_assignment_tpu.ops.sync_engine import (
     DM_ACT, DM_CLAIM, DM_COLS, DM_COUNT, DM_MEM, DM_OWNER, DM_REQ,
-    DM_STATE, SyncState, _round_key, claim_max_rounds, slot_bits)
+    DM_STATE, SyncState, _round_key_rs, claim_max_rounds, slot_bits)
 
 # slot kinds (remote events): fill requests and eviction notices
 K_NONE, K_RD, K_WR, K_UP, K_EVS, K_EVM, K_PROBE = 0, 1, 2, 3, 4, 5, 6
@@ -241,10 +241,54 @@ def _fold_deep(cfg: SystemConfig, st: SyncState, tiles, w_oa, w_val,
     return out
 
 
+class XlaIndexOps:
+    """The round middle's index-op seam: the 7 scatter/gather families
+    between the folds, as native XLA ops (gather/scatter HLOs).
+
+    ``deep_round_core`` routes EVERY dynamic memory access through one
+    of these methods; everything else in the middle is dense. The
+    fused Pallas round kernel (ops/pallas_round) substitutes
+    ``RoutedIndexOps`` — the same seven ops as exact one-hot f32
+    matmuls, which Mosaic can lower (TPU Pallas has no vector
+    gather/scatter) — and inherits the rest of the middle verbatim, so
+    the two paths are bit-identical by construction up to the routed
+    ops, whose exactness the parity tests pin.
+
+    Contracts: gather indices are in-range (callers clip); scatter
+    indices use the one-past-the-end sentinel for dropped lanes
+    (``mode="drop"`` here, zero one-hot rows in the routed version);
+    ``scatter_rows``/``scatter_col`` indices are unique among
+    non-dropped lanes (at most one committed slot per entry per wave —
+    the read-storm's duplicate-row commits are the one exception, and
+    the fused path refuses storm configs for exactly that reason)."""
+    native = True
+
+    def scatter_min(self, dest, idx, vals):
+        """dest[idx] = min(dest[idx], vals) with drop semantics."""
+        return dest.at[idx].min(vals, mode="drop")
+
+    def gather(self, plane, idx):
+        """plane[idx] for a 1-D plane; idx any shape, in-range."""
+        return plane[idx]
+
+    def gather_rows(self, mat, idx):
+        """mat[idx] for [M, K] mat -> [*idx.shape, K]."""
+        return mat[idx]
+
+    def scatter_rows(self, mat, idx, rows_):
+        """mat[idx] = rows_ with drop semantics; idx unique."""
+        return mat.at[idx].set(rows_, mode="drop")
+
+    def scatter_col(self, mat, idx, col, vals):
+        """mat[idx, col] = vals with drop semantics; idx unique."""
+        return mat.at[idx, col].set(vals, mode="drop")
+
+
 def round_step_deep(cfg: SystemConfig, st: SyncState,
                     with_events: bool = False,
                     return_stats: bool = False,
-                    fold_impl: str = "xla"):
+                    fold_impl: str = "xla",
+                    index_ops=None):
     """One deep-window round. See module docstring for the design.
 
     ``fold_impl`` selects how the two W-step folds execute: ``"xla"``
@@ -304,8 +348,65 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     if fold_impl == "pallas":
         from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_deep
         pre = pallas_deep.fold_pre(cfg, st, tiles, w_oa, w_val, w_live)
+
+        def fold_flags_fn(oc):
+            return pallas_deep.fold_flags(cfg, st, tiles, w_oa, w_val,
+                                          w_live, oc)
+
+        def fold_replay_fn(bad, oc):
+            return pallas_deep.fold_replay(cfg, st, tiles, w_oa, w_val,
+                                           w_live, bad, oc)
     else:
         pre = _fold_deep(cfg, st, tiles, w_oa, w_val, w_live)
+
+        def fold_flags_fn(oc):
+            return _fold_deep(cfg, st, tiles, w_oa, w_val, w_live,
+                              bad=None, ocode=oc)
+
+        def fold_replay_fn(bad, oc):
+            return _fold_deep(cfg, st, tiles, w_oa, w_val, w_live,
+                              bad=bad, ocode=oc)
+    core = deep_round_core(cfg, st.dm, st.round, st.seed, pre,
+                           fold_flags_fn, fold_replay_fn,
+                           index_ops if index_ops is not None
+                           else XlaIndexOps())
+    return _finish_round_deep(cfg, st, core, w_oa, w_val, with_events,
+                              return_stats)
+
+
+def deep_round_core(cfg: SystemConfig, dm0, round_, seed, pre,
+                    fold_flags_fn, fold_replay_fn, ix):
+    """The deep round's arbitration/composition/fan-out middle — from
+    the pre-pass fold's slots through the fan-out, i.e. everything
+    between the window build and the metrics update — with every
+    dynamic memory access routed through ``ix`` (XlaIndexOps, or the
+    fused kernel's RoutedIndexOps) and the two later folds injected as
+    callbacks (their backend differs per caller: lax.scan, the Pallas
+    fold kernels, or in-kernel array folds inside the fused round).
+
+    Pure array-in/array-out (``dm0`` [E, DM_COLS]; round/seed traced
+    scalars), so the IDENTICAL middle runs as the XLA reference path
+    AND inside ops/pallas_round's fused kernel — bit-identity of the
+    two paths reduces to exactness of the routed index ops, which the
+    parity tests pin. Returns a dict: post-round cache planes [C, N],
+    directory [E, DM_COLS], per-node metric delta rows [10, N], the
+    replay-fold output, and the dense internals the stats/events
+    tails consume."""
+    N, C, S = cfg.num_nodes, cfg.cache_size, 1 << cfg.block_bits
+    E = N * S
+    Q = cfg.deep_slots
+    G = cfg.deep_ownerval_slots
+    INV = int(CacheState.INVALID)
+    EXC = int(CacheState.EXCLUSIVE)
+    SHD = int(CacheState.SHARED)
+    D_U, D_S, D_EM = int(DirState.U), int(DirState.S), int(DirState.EM)
+    rows = jnp.arange(N, dtype=jnp.int32)
+    dm_own = dm0.reshape(N, S, DM_COLS)
+    # identity test: ix.native is a host bool class attribute
+    if cfg.deep_read_storm and ix.native is not True:
+        raise ValueError("deep_read_storm needs native index ops: the "
+                         "storm's duplicate-row commits are outside "
+                         "the routed scatters' uniqueness contract")
     kind, ent, sval = pre["kind"], pre["ent"], pre["sval"]   # [Q, N]
     is_req = (kind == K_RD) | (kind == K_WR) | (kind == K_UP)
     is_ev = (kind == K_EVS) | (kind == K_EVM)
@@ -323,7 +424,7 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     # fill requests.
     prio_bits = max(1, (N - 1).bit_length())
     SB = slot_bits(cfg)
-    rk = _round_key(cfg, st, rows)
+    rk = _round_key_rs(cfg, round_, seed, rows)
     prio = rk & ((1 << prio_bits) - 1)
     countdown = rk >> prio_bits
     # read-storm key layout (cfg.deep_read_storm): one extra is_rd bit
@@ -347,13 +448,13 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
         key_q = jnp.where(kind == K_RD,
                           key_q | (1 << (prio_bits + 1 + SB)), key_q)
     lane_idx = jnp.where(is_req | is_ev, ent, E).reshape(-1)
-    dm_claimed = st.dm.at[lane_idx, DM_CLAIM].min(
-        key_q.reshape(-1), mode="drop")
+    claim = ix.scatter_min(dm0[:, DM_CLAIM], lane_idx,
+                           key_q.reshape(-1))                 # [E]
 
     safe_ent = jnp.clip(ent, 0, E - 1)
     # fresh lane keys this round sit strictly below every stale key (the
     # DM_CLAIM countdown invariant, ops/sync_engine)
-    thresh = (jnp.maximum(claim_max_rounds(cfg) - st.round, 0) + 1) \
+    thresh = (jnp.maximum(claim_max_rounds(cfg) - round_, 0) + 1) \
         << (prio_bits + 1 + SB + ST)
     pmask = (1 << prio_bits) - 1
     prio_self = prio[None, :]                                # [1, N]
@@ -365,7 +466,7 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     # fresh fill request after our first request attempt; post-request
     # own HITS yield to fresh fill requests. Flag-free (lane keys
     # only), so the flag-pass fold below can consume it too.
-    own_lane = dm_claimed.reshape(N, S, DM_COLS)[:, :, DM_CLAIM].T
+    own_lane = claim.reshape(N, S).T
     o_fresh = own_lane < thresh                              # [S, N]
     o_ev = (own_lane & 1) == 1
     o_beats = ((own_lane >> (1 + SB)) & pmask) < prio[None, :]  # sender wins
@@ -398,13 +499,7 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     # zero extra index ops (measured: the slot-verdict variant's extra
     # [Q, N] gather cost more than its sharper flags bought back).
     if cfg.deep_exact_flags:
-        if fold_impl == "pallas":
-            from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_deep
-            fpass = pallas_deep.fold_flags(cfg, st, tiles, w_oa,
-                                           w_val, w_live, o_code)
-        else:
-            fpass = _fold_deep(cfg, st, tiles, w_oa, w_val, w_live,
-                               bad=None, ocode=o_code)
+        fpass = fold_flags_fn(o_code)
         flag_mark, flag_poison = fpass["mark"], fpass["poison"]
     else:
         flag_mark, flag_poison = pre["mark"], pre["poison"]
@@ -414,8 +509,8 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     flags_arr = (flag_mark.astype(jnp.int32) * F_MARK
                  + flag_poison.astype(jnp.int32)
                  * F_POISON).T.reshape(E)
-    side = jnp.stack([dm_claimed[:, DM_CLAIM], flags_arr], axis=-1)
-    got2 = side[safe_ent]                                    # [Q, N, 2]
+    side = jnp.stack([claim, flags_arr], axis=-1)
+    got2 = ix.gather_rows(side, safe_ent)                    # [Q, N, 2]
     lane_got, got_flags = got2[..., 0], got2[..., 1]
 
     # ---- truncation ------------------------------------------------------
@@ -430,7 +525,8 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     # home's priority needs no gather. Marks/poison over-approximate
     # committed touches (conservative): aborting on a ghost touch
     # costs a retry, never soundness.
-    prio_home = _round_key(cfg, st, safe_ent >> cfg.block_bits) & pmask
+    prio_home = (_round_key_rs(cfg, round_, seed,
+                               safe_ent >> cfg.block_bits) & pmask)
     home_wins = prio_home < prio_self                        # [Q, N]
     # the clean-requester relaxation (round 4): the poison rule exists
     # to break composition-order cycles, and every node in such a cycle
@@ -475,9 +571,9 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
         # the earlier slot's lower key wins the earlier wave).
         cand = is_req & ~req_abort & ~won_any
         wave_idx = jnp.where(cand, ent, E).reshape(-1)
-        lane_j = jnp.full((E,), _INT_MAX, jnp.int32).at[
-            wave_idx].min(key_q.reshape(-1), mode="drop")
-        won_j = cand & (lane_j[safe_ent] == key_q)
+        lane_j = ix.scatter_min(jnp.full((E,), _INT_MAX, jnp.int32),
+                                wave_idx, key_q.reshape(-1))
+        won_j = cand & (ix.gather(lane_j, safe_ent) == key_q)
         won_list.append(won_j)
         won_any = won_any | won_j
     # ---- read-storm bulk grant (cfg.deep_read_storm) ---------------------
@@ -556,12 +652,7 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     # ---- replay fold (committed prefix) ----------------------------------
     # the fold truncates retirement at the first bad slot or
     # yield-unsafe own touch; rp["comm"] marks the slots that committed
-    if fold_impl == "pallas":
-        rp = pallas_deep.fold_replay(cfg, st, tiles, w_oa, w_val,
-                                     w_live, bad, o_code)
-    else:
-        rp = _fold_deep(cfg, st, tiles, w_oa, w_val, w_live, bad=bad,
-                        ocode=o_code)
+    rp = fold_replay_fn(bad, o_code)
 
     # ---- dense merge of own rows -----------------------------------------
     # DM_ACT packing (round 4, wave-stamp fan-out): (round << 11) |
@@ -575,7 +666,7 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     # read/write wave sequences resolve exactly: each holder compares
     # its own acquisition against the stamps instead of sharing one
     # blanket action.
-    rtag = st.round << 11
+    rtag = round_ << 11
     acc = rp["act_acc"]                                      # [S, N]
     touched = rp["touched"]
     act_col = jnp.where(
@@ -589,7 +680,7 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     # can precede — mid-window foreign hit-writes on marked entries
     # truncate, so cv_post is the serialization-consistent source)
     g_flat = rp["g_ci"] * N + jnp.clip(rp["g_owner"], 0, N - 1)
-    g_vals = rp["cv_req"].reshape(-1)[g_flat]                # [G, N]
+    g_vals = ix.gather(rp["cv_req"].reshape(-1), g_flat)     # [G, N]
     dmm_m = rp["dmm"]
     cv_m = rp["cv"]
     cv_req_m = rp["cv_req"]
@@ -606,7 +697,7 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
         act_col.T,
         jnp.where(touched, jnp.broadcast_to(rows[None, :], (S, N)),
                   dm_own[:, :, DM_REQ].T).T,
-        dm_claimed.reshape(N, S, DM_COLS)[:, :, DM_CLAIM],
+        claim.reshape(N, S),
     ], axis=-1).reshape(E, DM_COLS)
     dm = merged
 
@@ -656,7 +747,7 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
             kr = g_rows8[..., DM_COLS] & 0xFFFF              # [Q, N]
             ke = g_rows8[..., DM_COLS] >> 16
         else:
-            g_rows = dm[safe_ent]                            # [Q, N, cols]
+            g_rows = ix.gather_rows(dm, safe_ent)            # [Q, N, cols]
         r_state = g_rows[..., DM_STATE]
         r_cnt = g_rows[..., DM_COUNT]
         r_own = g_rows[..., DM_OWNER]
@@ -666,7 +757,7 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
         # memory as the owner value: SHARED lines are clean in this
         # protocol, and the promoted-E line's value equals mem
         r_pend = (r_state == D_EM) & (r_own == -1)
-        prev_fresh = (r_act >> 11) == st.round
+        prev_fresh = (r_act >> 11) == round_
         # the round-value channel rides DM_REQ's high bits (written by
         # earlier waves' commit scatters): bit 8 = owner wrote this
         # round (bits 0-7 its value — write-allocate leaves memory
@@ -677,7 +768,8 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
                            (g_rows[..., DM_REQ] >> 16) & 0x3FF, 0)
         own_val = jnp.where(
             r_pend, r_mem,
-            cv_req_m.reshape(-1)[r_ci * N + jnp.clip(r_own, 0, N - 1)])
+            ix.gather(cv_req_m.reshape(-1),
+                      r_ci * N + jnp.clip(r_own, 0, N - 1)))
         own_val = jnp.where((rv_got & 0x200) != 0, r_mem, own_val)
         own_val = jnp.where((rv_got & 0x100) != 0, rv_got & 0xFF,
                             own_val)
@@ -846,7 +938,7 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
             [n_state, n_cnt, n_own, n_mem, n_act,
              req_col | (rv_new << 16), key_col],
             axis=-1).reshape(-1, DM_COLS)
-        dm = dm.at[t_idx].set(t_rows, mode="drop")
+        dm = ix.scatter_rows(dm, t_idx, t_rows)
 
         # reply patches on the requester's cache: committed remote rd
         # fills resolve E vs S and the fill value here. Accumulated
@@ -898,11 +990,11 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     # Non-home lines compare their acquisition stamp aw against kw/dw;
     # the home's line applies the exact act_h.
     line_e = jnp.clip(ca_c, 0, E - 1)                        # [C, N]
-    fan_fresh = (dm[:, DM_ACT] >> 11) == st.round
+    fan_fresh = (dm[:, DM_ACT] >> 11) == round_
     fan_packed = (jnp.where(fan_fresh,
                             ((dm[:, DM_ACT] & 0x7FF) | 0x800) << 16, 0)
                   | (dm[:, DM_REQ] & 0xFFFF))
-    line_f = fan_packed[line_e]                              # [C, N]
+    line_f = ix.gather(fan_packed, line_e)                   # [C, N]
     fresh = ((line_f >> 27) & 1) == 1
     l_ah = jnp.where(fresh, (line_f >> 25) & 3, ACT_NONE)
     l_promo = fresh & (((line_f >> 24) & 1) == 1)
@@ -922,16 +1014,17 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     cs_c = jnp.where(kill, INV,
                      jnp.where(promo, EXC,
                                jnp.where(down, SHD, cs_c)))
-    dm = dm.at[jnp.where(promo, line_e, E).reshape(-1), DM_OWNER].set(
-        jnp.broadcast_to(rows[None, :], (C, N)).reshape(-1),
-        mode="drop")
+    dm = ix.scatter_col(dm, jnp.where(promo, line_e, E).reshape(-1),
+                        DM_OWNER,
+                        jnp.broadcast_to(rows[None, :],
+                                         (C, N)).reshape(-1))
 
     # ---- bookkeeping -----------------------------------------------------
     # replay counters already include retired *remote* transactions (a
     # remote txn retires iff its slots committed — both encoded in
     # trunc), so the committed-slot sums are not added again
     cntr = rp["cnt"]
-    deltas = jnp.sum(jnp.stack([
+    delta_rows = jnp.stack([
         rp["n_ret"], rp["rh"], rp["wh"],
         cntr["rd_miss"],
         cntr["wr_miss"],
@@ -941,7 +1034,29 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
         cntr["ev"],
         jnp.sum(kill, axis=0, dtype=jnp.int32),
         jnp.sum(promo, axis=0, dtype=jnp.int32),
-    ]), axis=1)
+    ])                                                       # [10, N]
+    return dict(
+        ca_c=ca_c, cv_c=cv_c, cs_c=cs_c, dm=dm, rp=rp,
+        delta_rows=delta_rows,
+        # dense internals for the stats tail (all [Q, N]/[N] bools)
+        kind=kind, is_req=is_req, is_ev=is_ev, won_any=won_any,
+        aborting=aborting, probe_bad=probe_bad,
+        commit_acc=commit_acc, rel_acc=rel_acc,
+        clean_self=clean_self, storm_committed=storm_committed)
+
+
+def _finish_round_deep(cfg: SystemConfig, st: SyncState, core,
+                       w_oa, w_val, with_events: bool,
+                       return_stats: bool):
+    """Fold a deep_round_core result back into the SyncState: metrics
+    from the per-node delta rows, window-cursor/horizon advance, and
+    the optional stats/events extras. Shared by the XLA reference path
+    and the fused-kernel path (ops/pallas_round), which produces the
+    same core output dict from the kernel's output buffers."""
+    W = cfg.drain_depth + cfg.txn_width
+    rp = core["rp"]
+    kind = core["kind"]
+    deltas = jnp.sum(core["delta_rows"], axis=1)
     mt = st.metrics
     metrics = mt.replace(
         rounds=mt.rounds + 1,
@@ -956,15 +1071,16 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
         invalidations=mt.invalidations + deltas[8],
         promotions=mt.promotions + deltas[9],
     )
-    out = st.replace(cache_addr=ca_c.T, cache_val=cv_c.T,
-                     cache_state=cs_c.T,
-                     dm=dm, idx=st.idx + rp["n_ret"],
+    out = st.replace(cache_addr=core["ca_c"].T, cache_val=core["cv_c"].T,
+                     cache_state=core["cs_c"].T,
+                     dm=core["dm"], idx=st.idx + rp["n_ret"],
                      horizon=jnp.clip(
                          rp["n_ret"] + cfg.deep_horizon_slack, 2,
                          1 << 20),
                      round=st.round + 1, metrics=metrics)
     if return_stats:
         s_ = lambda x: jnp.sum(x, dtype=jnp.int32)
+        is_req, is_ev = core["is_req"], core["is_ev"]
         stats = dict(
             n_ret=s_(rp["n_ret"]), truncated=s_(rp["truncated"]),
             stopped=s_(rp["stopped"]), seen_req=s_(rp["seen_req"]),
@@ -972,19 +1088,22 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
             att_rd=s_(kind == K_RD), att_wr=s_(kind == K_WR),
             att_up=s_(kind == K_UP), att_evs=s_(kind == K_EVS),
             att_evm=s_(kind == K_EVM), att_probe=s_(kind == K_PROBE),
-            lost=s_((is_req | is_ev) & ~won_any & ~aborting
-                    & ~storm_committed),
-            abort_poison=s_(aborting & is_req),
-            abort_mark=s_(aborting & is_ev),
-            probe_bad=s_(probe_bad),
-            committed=s_(commit_acc), released=s_(rel_acc),
-            clean=s_(clean_self), storm=s_(storm_committed),
+            lost=s_((is_req | is_ev) & ~core["won_any"]
+                    & ~core["aborting"] & ~core["storm_committed"]),
+            abort_poison=s_(core["aborting"] & is_req),
+            abort_mark=s_(core["aborting"] & is_ev),
+            probe_bad=s_(core["probe_bad"]),
+            committed=s_(core["commit_acc"]),
+            released=s_(core["rel_acc"]),
+            clean=s_(core["clean_self"]),
+            storm=s_(core["storm_committed"]),
             stop_overq=s_(rp["s_overq"]), stop_overg=s_(rp["s_overg"]),
             stop_dup=s_(rp["s_dup"]), stop_dep=s_(rp["s_dep"]),
             stop_live=s_(rp["s_live"]))
         return out, stats
     if not with_events:
         return out
+    offs_w = jnp.arange(W, dtype=jnp.int32)[:, None]
     events = {"retired": offs_w.T < rp["n_ret"][:, None],   # [N, W]
               "op": w_oa.T >> 28, "addr": w_oa.T & 0x0FFFFFFF,
               "value": w_val.T}

@@ -1,0 +1,407 @@
+"""Fused Pallas round kernel: one deep-engine round in one kernel.
+
+ROADMAP item 1 names the gap: the deep round is **index-bound** — of
+the ~0.82 ms round at deep@4096, ~550 µs sits in 7 separate
+gather/scatter XLA fusions (claim scatter-min, side gather, g-slot
+gather, per-wave row gather, owner-value gather, commit row scatter,
+fan-out gather + promotion scatter), each round-tripping the [E, 7]
+directory and [C, N] cache through HBM, plus ~95 µs of copies and
+transposes adapting layouts between them. This module executes the
+ENTIRE round — window folds, arbitration, composition, fan-out, metric
+deltas — as a single ``pl.pallas_call`` instance with all state
+resident in VMEM, so per-round state touches HBM exactly twice (one
+load, one store).
+
+How it fuses without rewriting the engine
+-----------------------------------------
+The round middle was already layout-shared with the fold kernels
+(ops/pallas_deep); this PR routes its seven index-op families through
+an injectable strategy (``deep_engine.XlaIndexOps``) and the fused
+kernel substitutes :class:`RoutedIndexOps` while running the IDENTICAL
+``deep_engine.deep_round_core`` middle and the identical
+``pallas_deep._run_fold`` fold code (ref-style slicing works on plain
+arrays) in-kernel. Bit-identity of the fused path therefore reduces to
+exactness of the routed ops, which tests/test_pallas_round.py pins
+against the XLA reference — interpret mode on CPU, the
+tests/test_pallas_deep.py pattern.
+
+Routing index ops through the MXU (Mosaic has no vector gather)
+---------------------------------------------------------------
+TPU Pallas cannot lower vector gathers/scatters, so every dynamic
+access becomes an exact one-hot f32 matmul over entry tiles:
+
+* gather   out[r] = sum_e [idx[r] == e] * v[e]   (row one-hot @ values)
+* scatter  out[e] = sum_r [idx[r] == e] * v[r]   (col one-hot @ values)
+
+int32 payloads split into two 16-bit halves per column; each half is a
+nonnegative integer < 2**16, exactly representable in f32, and every
+output sums at most ONE nonzero product (gathers are functions;
+scatter indices are unique per committed wave), so the matmul results
+are exact integers under any float precision. One-hot tiles are built
+``_TILE`` entries at a time (iota compare — transpose-free for
+gathers, one [1, R] -> [R, 1] reshape for scatters) and contracted
+with ``precision=HIGHEST``.
+
+The claim/wave scatter-MIN cannot ride a sum, so it uses the chunked
+exponent trick: all fresh lane keys this round share the same
+countdown high bits (the DM_CLAIM invariant, ops/sync_engine), and the
+low ``L = prio_bits + 1 + SB + ST <= 16`` bits are minimised 4 bits at
+a time. Contenders route ``2**(A - G*chunk)`` (A=100, G=15) and the
+per-entry minimum chunk is recovered as ``#{v : sum < 2**(A - G*v)}``:
+with fewer than 2**14 contenders the rounded sum stays strictly inside
+``[2**(A-G*m), 2**(A-G*(m-1)))`` for minimum chunk m (sum of positive
+powers of two, RN summation error < 0.1%, 16*15 = 240-step exponent
+ladder inside f32 normal range), so 16 dense threshold compares read
+off the minimum exactly. Contenders then narrow to those matching the
+minimum chunk (one routed gather-back) and the next 4 bits repeat —
+at most 4 passes. ``supported`` caps ``deep_slots * num_nodes < 2**14``
+for the rounding margin (deep@4096 headline: 3 * 4096 = 12288).
+
+VMEM budget at the deep@4096 headline (N=4096, S=16, C=4, Q=3, W=16):
+directory [65536, 7] i32 = 1.75 MB, cache 3x[4, 4096] = 192 KB,
+window 3x[16, 4096] = 768 KB, fold carry ~250 [1, 4096] vecs ~ 4 MB,
+largest routed one-hot tile [12288, 128] f32 = 6 MB transient —
+~13 MB peak, inside a 16 MB core. The kernel's HBM contract per round
+is its I/O: ~3.8 MB vs the ~3.4 GB/round the unfused path moves
+(obs/roofline measures 191377.95 bytes/instr on the XLA path).
+
+Scope: any workload kind (the [W, N] window is built in XLA exactly as
+the reference path builds it), any deep_waves, exact flags on or off.
+NOT supported (``supported`` returns False, callers fall back to the
+XLA path): read-storm configs (duplicate-row storm commits break the
+routed scatters' uniqueness contract), with_events/return_stats
+callers, and node counts past the scatter-min rounding margin.
+Carrying K > 1 rounds per kernel launch (window build in-kernel for
+procedural workloads) is the named follow-up in PERF.md.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.procedural import procedural_instr
+from ue22cs343bb1_openmp_assignment_tpu.ops import deep_engine
+from ue22cs343bb1_openmp_assignment_tpu.ops.deep_engine import (
+    state_tiles)
+from ue22cs343bb1_openmp_assignment_tpu.ops.pallas_burst import (
+    _interpret)
+from ue22cs343bb1_openmp_assignment_tpu.ops.pallas_deep import (
+    _cat, _run_fold)
+from ue22cs343bb1_openmp_assignment_tpu.ops.sync_engine import (
+    DM_COLS, DM_COUNT, DM_MEM, DM_OWNER, DM_STATE, SyncState,
+    claim_max_rounds, slot_bits)
+
+# chunked scatter-min weight ladder: contenders route 2**(A - G*chunk);
+# G=15 leaves a 2**14 contender/rounding margin between adjacent
+# chunk thresholds and the 16-step ladder spans [2**-125, 2**100],
+# inside f32 normal range (module docstring)
+_MIN_A, _MIN_G = 100, 15
+
+_HI = jax.lax.Precision.HIGHEST
+
+
+def _tile_of(M: int) -> int:
+    """One-hot entry-tile width: 128 lanes when the domain allows."""
+    return 128 if M % 128 == 0 else M
+
+
+def _split16(v):
+    """int32 [R, K] -> f32 [R, 2K]: low then high 16-bit halves, each a
+    nonnegative integer < 2**16 (exact in f32)."""
+    u = v.astype(jnp.uint32)
+    return jnp.concatenate([(u & 0xFFFF).astype(jnp.float32),
+                            (u >> 16).astype(jnp.float32)], axis=-1)
+
+
+def _join16(lo, hi):
+    """Reassemble int32 from exact-integer f32 halves (wrapping shift
+    restores negative values bit-for-bit)."""
+    return (hi.astype(jnp.int32) << 16) | lo.astype(jnp.int32)
+
+
+def _route_gather(mat, idx):
+    """Exact one-hot gather: mat [M, K] int32 at idx (any shape) ->
+    [*idx.shape, K]. Out-of-range indices yield zero rows (callers
+    clip; the scatter-min narrowing relies on the zero)."""
+    M, K = mat.shape
+    TJ = _tile_of(M)
+    V = _split16(mat)                                        # [M, 2K]
+    flat = idx.reshape(-1, 1)                                # [R, 1]
+    R = flat.shape[0]
+
+    def body(i, acc):
+        t0 = i * TJ
+        iota = jax.lax.broadcasted_iota(jnp.int32, (1, TJ), 1) + t0
+        oh = (flat == iota).astype(jnp.float32)              # [R, TJ]
+        vt = jax.lax.dynamic_slice(V, (t0, 0), (TJ, 2 * K))
+        return acc + jax.lax.dot(oh, vt, precision=_HI)
+
+    acc = jax.lax.fori_loop(0, M // TJ, body,
+                            jnp.zeros((R, 2 * K), jnp.float32))
+    return _join16(acc[:, :K], acc[:, K:]).reshape(idx.shape + (K,))
+
+
+def _route_scatter(mat, idx, rows_):
+    """Exact one-hot scatter: rows_ [R, K] into mat [M, K] at idx [R].
+    Out-of-range idx (the one-past-the-end drop sentinel) routes
+    nowhere; in-range idx are unique (deep_engine.XlaIndexOps
+    contract), so each written entry sums exactly one contribution.
+    A ones column rides along as the hit count selecting written
+    entries from kept ones."""
+    M, K = mat.shape
+    TJ = _tile_of(M)
+    V = jnp.concatenate([_split16(rows_),
+                         jnp.ones((rows_.shape[0], 1), jnp.float32)],
+                        axis=-1)                             # [R, 2K+1]
+    flat = idx.reshape(1, -1)                                # [1, R]
+
+    def body(i, acc):
+        t0 = i * TJ
+        iota = jax.lax.broadcasted_iota(jnp.int32, (TJ, 1), 0) + t0
+        oh = (iota == flat).astype(jnp.float32)              # [TJ, R]
+        out_t = jax.lax.dot(oh, V, precision=_HI)            # [TJ, 2K+1]
+        return jax.lax.dynamic_update_slice(acc, out_t, (t0, 0))
+
+    acc = jax.lax.fori_loop(0, M // TJ, body,
+                            jnp.zeros((M, 2 * K + 1), jnp.float32))
+    hit = acc[:, -1:] > 0
+    return jnp.where(hit, _join16(acc[:, :K], acc[:, K:2 * K]), mat)
+
+
+def _route_min(idx, low, in_mask, M, L):
+    """Per-entry minimum of contenders' low L-bit values via the
+    chunked exponent ladder (module docstring). idx [R] int32 (any
+    value outside [0, M) is dropped), low [R] the masked key low bits.
+    Returns (has [M] bool, min_low [M] int32)."""
+    nch = max(1, (L + 3) // 4)
+    still = in_mask
+    min_low = jnp.zeros((M,), jnp.int32)
+    has = None
+    TJ = _tile_of(M)
+    flat = idx.reshape(1, -1)                                # [1, R]
+    for c in range(nch):
+        sh = 4 * (nch - 1 - c)
+        chunk = (low >> sh) & 15                             # [R]
+        w = jnp.zeros(idx.shape, jnp.float32)
+        for v in range(16):
+            w = jnp.where(chunk == v,
+                          jnp.float32(2.0 ** (_MIN_A - _MIN_G * v)), w)
+        w = jnp.where(still, w, 0.0)[:, None]                # [R, 1]
+
+        def body(i, acc):
+            t0 = i * TJ
+            iota = (jax.lax.broadcasted_iota(jnp.int32, (TJ, 1), 0)
+                    + t0)
+            oh = (iota == flat).astype(jnp.float32)          # [TJ, R]
+            s_t = jax.lax.dot(oh, w, precision=_HI)          # [TJ, 1]
+            return jax.lax.dynamic_update_slice(acc, s_t, (t0, 0))
+
+        ssum = jax.lax.fori_loop(0, M // TJ, body,
+                                 jnp.zeros((M, 1), jnp.float32))[:, 0]
+        if has is None:
+            has = ssum > 0.0
+        cstar = jnp.zeros((M,), jnp.int32)
+        for v in range(16):
+            cstar = cstar + (
+                ssum < jnp.float32(2.0 ** (_MIN_A - _MIN_G * v))
+            ).astype(jnp.int32)
+        cstar = jnp.minimum(cstar, 15)                # no-contender: 16
+        min_low = (min_low << 4) | jnp.where(has, cstar, 0)
+        if c < nch - 1:
+            back = _route_gather(cstar[:, None], idx)[:, 0]
+            still = still & (chunk == back)
+    return has, min_low
+
+
+class RoutedIndexOps:
+    """deep_engine.XlaIndexOps as exact one-hot f32 matmul routing —
+    the Mosaic-lowerable form of the round middle's seven index-op
+    families (module docstring). Usable outside the kernel too (plain
+    jnp), which is how the fast parity tests pin the routing math
+    without paying a Pallas trace."""
+    native = False
+
+    def __init__(self, cfg: SystemConfig, round_):
+        N = cfg.num_nodes
+        prio_bits = max(1, (N - 1).bit_length())
+        # low-bit width of the lane key below the shared countdown
+        # (deep_engine key layout: prio | [is_rd] | slot | ev)
+        self._L = (prio_bits + 1 + slot_bits(cfg)
+                   + (1 if cfg.deep_read_storm else 0))
+        self._cd = jnp.maximum(
+            claim_max_rounds(cfg) - jnp.asarray(round_), 0
+        ).astype(jnp.int32)
+
+    def scatter_min(self, dest, idx, vals):
+        # contract: vals are this round's lane keys — identical
+        # countdown above bit L, so min(dest, countdown<<L | min_low)
+        # reproduces the scatter-min exactly (fresh < stale, the
+        # DM_CLAIM invariant)
+        M = dest.shape[0]
+        in_mask = (idx >= 0) & (idx < M)
+        low = vals & ((1 << self._L) - 1)
+        has, min_low = _route_min(idx, low, in_mask, M, self._L)
+        fresh = (self._cd << self._L) | min_low
+        return jnp.where(has, jnp.minimum(dest, fresh), dest)
+
+    def gather(self, plane, idx):
+        return _route_gather(plane[:, None], idx)[..., 0]
+
+    def gather_rows(self, mat, idx):
+        return _route_gather(mat, idx)
+
+    def scatter_rows(self, mat, idx, rows_):
+        return _route_scatter(mat, idx, rows_)
+
+    def scatter_col(self, mat, idx, col, vals):
+        newc = _route_scatter(mat[:, col:col + 1], idx, vals[:, None])
+        return jnp.concatenate([mat[:, :col], newc, mat[:, col + 1:]],
+                               axis=1)
+
+
+def supported(cfg: SystemConfig) -> bool:
+    """Can the fused round kernel run this config bit-identically?
+
+    Storm configs are out (duplicate-row commits break the routed
+    scatter uniqueness contract) and deep_slots * num_nodes must stay
+    under the chunked scatter-min's 2**14 contender/rounding margin.
+    Everything else — workload kind, waves, flag mode, protocol
+    variant — is in scope."""
+    return (cfg.deep_window
+            and not cfg.deep_read_storm
+            and cfg.deep_slots * cfg.num_nodes < (1 << 14))
+
+
+def io_contract_bytes(cfg: SystemConfig) -> tuple:
+    """(input_bytes, output_bytes) of one fused-round launch — the
+    kernel's per-round HBM contract (everything else stays in VMEM).
+    Pure shape arithmetic; obs/cli.py turns it into the perf-report's
+    ``io-contract`` roofline row (roofline.io_contract_record)."""
+    N, C, S = cfg.num_nodes, cfg.cache_size, 1 << cfg.block_bits
+    E = N * S
+    W = cfg.drain_depth + cfg.txn_width
+    elems_in = 2 * N + E * DM_COLS + 3 * C * N + 3 * W * N + N
+    elems_out = E * DM_COLS + 3 * C * N + N + 10 * N
+    return 4 * elems_in, 4 * elems_out
+
+
+def _round_kernel(cfg: SystemConfig, params_ref, dm_ref, ca_ref,
+                  cv_ref, cs_ref, woa_ref, wval_ref, wlive_ref,
+                  hor_ref, dm_out_ref, cache_out_ref, nret_ref,
+                  delta_ref):
+    """The whole round, one kernel instance: three in-kernel folds
+    (pallas_deep._run_fold on VMEM arrays) around the shared
+    deep_round_core middle with routed index ops. State never leaves
+    VMEM between the folds and the fan-out."""
+    N, C, S = cfg.num_nodes, cfg.cache_size, 1 << cfg.block_bits
+    round_ = params_ref[0, 0]
+    seed = params_ref[1, 0]
+    dm0 = dm_ref[...]
+    dm_own = dm0.reshape(N, S, DM_COLS)
+    dm_t4 = tuple(dm_own[:, :, col].T
+                  for col in (DM_STATE, DM_COUNT, DM_OWNER, DM_MEM))
+    ca_t, cv_t, cs_t = ca_ref[...], cv_ref[...], cs_ref[...]
+    w_oa, w_val = woa_ref[...], wval_ref[...]
+    w_live, hor = wlive_ref[...], hor_ref[...]
+
+    def fold(bad, ocode):
+        return _run_fold(cfg, N, ca_t, cv_t, cs_t, dm_t4[0], dm_t4[1],
+                         dm_t4[2], dm_t4[3], w_oa, w_val, w_live, hor,
+                         bad, ocode)
+
+    cb = lambda rows: jnp.concatenate(rows, axis=0)
+
+    def flags_of(fin):
+        return dict(mark=cb(fin["mark"]), poison=cb(fin["poison"]))
+
+    fin0 = fold(None, None)
+    pre = dict(kind=_cat(fin0["kind"]), ent=_cat(fin0["ent"]),
+               sval=_cat(fin0["sval"]), **flags_of(fin0))
+
+    def fold_flags_fn(oc):
+        return flags_of(fold(None, oc))
+
+    def fold_replay_fn(bad, oc):
+        fin = fold(bad, oc)
+        return dict(
+            ca=_cat(fin["ca"]), cv=_cat(fin["cv"]), cs=_cat(fin["cs"]),
+            cv_src=_cat(fin["cv_src"]), cv_req=_cat(fin["cv_req"]),
+            cv_req_src=_cat(fin["cv_req_src"]), lwh=cb(fin["lwh"]),
+            dms=_cat(fin["dms"]), dmc=_cat(fin["dmc"]),
+            dmo=_cat(fin["dmo"]), dmm=_cat(fin["dmm"]),
+            dmm_src=_cat(fin["dmm_src"]), touched=cb(fin["touched"]),
+            act_acc=_cat(fin["act_acc"]), comm=cb(fin["comm"]),
+            rel=cb(fin["rel"]), relv=_cat(fin["relv"]),
+            g_owner=_cat(fin["g_owner"]), g_ci=_cat(fin["g_ci"]),
+            n_ret=fin["n_ret"][0], rh=fin["rh"][0], wh=fin["wh"][0],
+            cnt=dict(rd_miss=fin["c_rd"][0], wr_miss=fin["c_wr"][0],
+                     upg=fin["c_up"][0], ev=fin["c_ev"][0]))
+
+    core = deep_engine.deep_round_core(
+        cfg, dm0, round_, seed, pre, fold_flags_fn, fold_replay_fn,
+        RoutedIndexOps(cfg, round_))
+    dm_out_ref[...] = core["dm"]
+    cache_out_ref[...] = jnp.concatenate(
+        [core["ca_c"], core["cv_c"], core["cs_c"]], axis=0)
+    nret_ref[...] = core["rp"]["n_ret"][None, :]
+    delta_ref[...] = core["delta_rows"]
+
+
+def _call_round(cfg, params, dm, ca_t, cv_t, cs_t, w_oa, w_val,
+                w_live, hor2):
+    N, C, S = cfg.num_nodes, cfg.cache_size, 1 << cfg.block_bits
+    E = N * S
+    W = cfg.drain_depth + cfg.txn_width
+    blk = lambda r, c: pl.BlockSpec((r, c), lambda i: (0, 0))
+    shp = lambda r, c: jax.ShapeDtypeStruct((r, c), jnp.int32)
+    return pl.pallas_call(
+        functools.partial(_round_kernel, cfg),
+        grid=(1,),
+        in_specs=[blk(2, N), blk(E, DM_COLS), blk(C, N), blk(C, N),
+                  blk(C, N), blk(W, N), blk(W, N), blk(W, N),
+                  blk(1, N)],
+        out_specs=[blk(E, DM_COLS), blk(3 * C, N), blk(1, N),
+                   blk(10, N)],
+        out_shape=[shp(E, DM_COLS), shp(3 * C, N), shp(1, N),
+                   shp(10, N)],
+        interpret=_interpret(),
+    )(params, dm, ca_t, cv_t, cs_t, w_oa, w_val, w_live, hor2)
+
+
+def round_step_deep_fused(cfg: SystemConfig, st: SyncState) -> SyncState:
+    """One deep round through the fused kernel — bit-identical to
+    ``deep_engine.round_step_deep`` on ``supported`` configs
+    (tests/test_pallas_round.py). The [W, N] window is built in XLA
+    exactly as the reference path builds it (procedural hash or
+    stored-trace gather); everything after enters the kernel once."""
+    N, C, S = cfg.num_nodes, cfg.cache_size, 1 << cfg.block_bits
+    W = cfg.drain_depth + cfg.txn_width
+    T = st.instr_pack.shape[1]
+    rows = jnp.arange(N, dtype=jnp.int32)
+    offs_w = jnp.arange(W, dtype=jnp.int32)[:, None]
+    w_idx = st.idx[None, :] + offs_w
+    w_live = w_idx < st.instr_count[None, :]
+    if cfg.procedural:
+        w_oa, w_val = procedural_instr(cfg, rows[None, :], w_idx)
+    else:
+        w_flat = rows[None, :] * T + jnp.minimum(w_idx, T - 1)
+        w = st.instr_pack.reshape(N * T, 2)[w_flat]
+        w_oa, w_val = w[..., 0], w[..., 1]
+    ca_t, cv_t, cs_t, _ = state_tiles(cfg, st)
+    params = jnp.stack([jnp.broadcast_to(st.round, (N,)),
+                        jnp.broadcast_to(st.seed, (N,))]
+                       ).astype(jnp.int32)
+    dm_out, cache_out, nret, delta_rows = _call_round(
+        cfg, params, st.dm, ca_t, cv_t, cs_t, w_oa, w_val,
+        w_live.astype(jnp.int32), st.horizon[None, :])
+    core = dict(ca_c=cache_out[:C], cv_c=cache_out[C:2 * C],
+                cs_c=cache_out[2 * C:], dm=dm_out,
+                rp=dict(n_ret=nret[0]), delta_rows=delta_rows,
+                kind=None)
+    return deep_engine._finish_round_deep(cfg, st, core, w_oa, w_val,
+                                          False, False)

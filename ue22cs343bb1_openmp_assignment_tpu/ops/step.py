@@ -108,32 +108,61 @@ def cycle(cfg: SystemConfig, state: SimState,
     f_upd, f_req, f_stats = frontend.instruction_phase(cfg, state, may_issue)
 
     # ---- merge write intents (disjoint by node: msg XOR instr) -----------
+    # ONE packed scatter per state family instead of five scalar-column
+    # scatters (the PERF.md round-5 fragmentation leftover): the three
+    # cache columns share (rows, cidx) and the memory/directory columns
+    # share the handler's p_block, so each family stacks its planes,
+    # gathers the old target row once, where-selects per column (a
+    # column's unset mask keeps the old value — identical semantics to
+    # the old per-column drop scatters) and commits one row scatter.
     C = cfg.cache_size
     cidx = jnp.where(mv.has_msg, m_upd["cache_idx"], f_upd["cache_idx"])
 
-    def scatter_cache(arr, m_int, f_int):
-        mask = jnp.where(mv.has_msg, m_int[0], f_int[0])
-        val = jnp.where(mv.has_msg, m_int[1], f_int[1])
-        safe = jnp.where(mask, cidx, C)
-        return arr.at[rows, safe].set(val, mode="drop")
+    def merged(m_int, f_int):
+        return (jnp.where(mv.has_msg, m_int[0], f_int[0]),
+                jnp.where(mv.has_msg, m_int[1], f_int[1]))
 
-    cache_state = scatter_cache(state.cache_state, m_upd["cache_state"],
-                                f_upd["cache_state"])
-    cache_addr = scatter_cache(state.cache_addr, m_upd["cache_addr"],
-                               f_upd["cache_addr"])
-    cache_val = scatter_cache(state.cache_val, m_upd["cache_val"],
-                              f_upd["cache_val"])
+    cmasks, cvals = zip(
+        merged(m_upd["cache_state"], f_upd["cache_state"]),
+        merged(m_upd["cache_addr"], f_upd["cache_addr"]),
+        merged(m_upd["cache_val"], f_upd["cache_val"]))
+    cache3 = jnp.stack([state.cache_state, state.cache_addr,
+                        state.cache_val], axis=-1)        # [N, C, 3]
+    old_c = cache3[rows, jnp.clip(cidx, 0, C - 1)]        # [N, 3]
+    row_c = jnp.stack([jnp.where(m, v, old_c[:, k])
+                       for k, (m, v) in enumerate(zip(cmasks, cvals))],
+                      axis=-1)
+    any_c = cmasks[0] | cmasks[1] | cmasks[2]
+    cache3 = cache3.at[rows, jnp.where(any_c, cidx, C)].set(
+        row_c, mode="drop")
+    cache_state, cache_addr, cache_val = (
+        cache3[..., 0], cache3[..., 1], cache3[..., 2])
 
     M = cfg.mem_size
     mm, mi, mval = m_upd["mem"]
-    memory = state.memory.at[rows, jnp.where(mm, mi, M)].set(
-        mval, mode="drop")
     dm, di, dval = m_upd["dir_state"]
-    dir_state = state.dir_state.at[rows, jnp.where(dm, di, M)].set(
-        dval, mode="drop")
     bm, bi, bval = m_upd["dir_bv"]
-    dir_bitvec = state.dir_bitvec.at[rows, jnp.where(bm, bi, M)].set(
-        bval, mode="drop")
+    # the handlers emit one block index for all three (p_block); the
+    # nested where keeps the first set mask's index authoritative
+    hidx = jnp.where(mm, mi, jnp.where(dm, di, bi))
+    bv_i32 = jax.lax.bitcast_convert_type(state.dir_bitvec, jnp.int32)
+    home = jnp.concatenate(
+        [state.memory[..., None], state.dir_state[..., None], bv_i32],
+        axis=-1)                                          # [N, M, 2+Wb]
+    old_h = home[rows, jnp.clip(hidx, 0, M - 1)]          # [N, 2+Wb]
+    row_h = jnp.concatenate(
+        [jnp.where(mm, mval, old_h[:, 0])[:, None],
+         jnp.where(dm, dval, old_h[:, 1])[:, None],
+         jnp.where(bm[:, None],
+                   jax.lax.bitcast_convert_type(bval, jnp.int32),
+                   old_h[:, 2:])],
+        axis=-1)
+    any_h = mm | dm | bm
+    home = home.at[rows, jnp.where(any_h, hidx, M)].set(
+        row_h, mode="drop")
+    memory, dir_state = home[..., 0], home[..., 1]
+    dir_bitvec = jax.lax.bitcast_convert_type(home[..., 2:],
+                                              jnp.uint32)
 
     waiting = (state.waiting & ~m_upd["wait_clear"]) | f_upd["wait_set"]
     # stall-watchdog input: cycle the current wait began (-1 when idle)

@@ -406,11 +406,21 @@ def _round_key(cfg: SystemConfig, st: SyncState, rows: jnp.ndarray):
     """Per-round claim key: decreasing round countdown in the high bits,
     a reseeded bijective node-priority permutation in the low bits (see
     the DM_CLAIM comment at the top). Keys are unique per node."""
+    return _round_key_rs(cfg, st.round, st.seed, rows)
+
+
+def _round_key_rs(cfg: SystemConfig, round_, seed, rows: jnp.ndarray):
+    """`_round_key` on raw (round, seed) scalars instead of a SyncState
+    — pure uint32 arithmetic, so the fused Pallas round kernel
+    (ops/pallas_round) can recompute keys in-kernel from a two-scalar
+    params row rather than streaming a keys array through HBM."""
     N = cfg.num_nodes
     prio_bits = max(1, (N - 1).bit_length())
     mask = jnp.uint32((1 << prio_bits) - 1)
-    h = _mix((st.round.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
-             ^ (st.seed.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)))
+    h = _mix((jnp.asarray(round_).astype(jnp.uint32)
+              * jnp.uint32(0x9E3779B9))
+             ^ (jnp.asarray(seed).astype(jnp.uint32)
+                * jnp.uint32(0x85EBCA77)))
     x = rows.astype(jnp.uint32)
     x = (x * ((h << 1) | jnp.uint32(1)) + (h >> 7)) & mask
     x ^= x >> max(1, prio_bits // 2)
@@ -427,7 +437,8 @@ def _round_key(cfg: SystemConfig, st: SyncState, rows: jnp.ndarray):
     # unreachable by asserting the budget up front
     # (_assert_round_budget); only direct round_step callers can enter
     # it.
-    countdown = jnp.maximum(claim_max_rounds(cfg) - st.round, 0)
+    countdown = jnp.maximum(claim_max_rounds(cfg) - jnp.asarray(round_),
+                            0).astype(jnp.int32)
     return (countdown << prio_bits) | prio
 
 
@@ -445,6 +456,15 @@ def round_step(cfg: SystemConfig, st: SyncState,
     if cfg.deep_window:
         from ue22cs343bb1_openmp_assignment_tpu.ops.deep_engine import (
             round_step_deep)
+        if cfg.fused_round and not with_events:
+            from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_round
+            if pallas_round.supported(cfg):
+                # the ENTIRE round as one kernel — folds, arbitration,
+                # composition, fan-out — with state resident in VMEM
+                # (bit-identical: shared deep_round_core middle, routed
+                # index ops); unsupported configs fall through to the
+                # reference path below
+                return pallas_round.round_step_deep_fused(cfg, st)
         fold_impl = "xla"
         if cfg.pallas_burst and not with_events:
             from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_burst
