@@ -27,6 +27,109 @@ import sys
 import time
 
 
+def _serve_bench(args, jax):
+    """--serve: jobs/sec through the batched serving layer.
+
+    The measured unit is one full serve() pass over the fixed traffic
+    mix (serve.mixed_jobs: uniform / false_sharing / producer_consumer
+    / hotspot cycling, seeds 0..J-1) at the job shape --nodes x
+    --trace-len, through --serve-slots batch slots. The metric string
+    deliberately excludes the slot count: batch-B and the sequential
+    baseline (--serve-slots 1) record the same metric, so bench-diff
+    adjudicates batching as a regular IMPROVEMENT/REGRESSION verdict.
+    Padding waste rides the entry's serve block — a jobs/sec win that
+    came from padding shrinkage would show there.
+    """
+    from ue22cs343bb1_openmp_assignment_tpu import serve as serve_mod
+
+    n_jobs = args.serve_jobs or 2 * args.serve_slots
+    specs = serve_mod.mixed_jobs(n_jobs, nodes=args.nodes,
+                                 trace_len=args.trace_len)
+    max_cycles = args.max_cycles or 100_000
+    # the false-sharing mix component makes every node hammer one home
+    # block: at the scale-default queue_capacity=64 the home mailbox
+    # overflows (silent-drop quirk 6) and the dropped requester waits
+    # forever, so the mix scales capacity with the node count
+    qcap = args.queue_capacity or max(64, 2 * args.nodes)
+
+    def run():
+        return serve_mod.serve(specs, slots=args.serve_slots,
+                               chunk=args.chunk, max_cycles=max_cycles,
+                               queue_capacity=qcap)
+
+    from ue22cs343bb1_openmp_assignment_tpu.obs.phases import PhaseTimer
+    timer = PhaseTimer()
+    with timer.phase("warmup_compile"):
+        run()                      # compiles the wave for this slot shape
+
+    times = []
+    for _ in range(args.reps):
+        t0 = time.perf_counter()
+        doc = run()
+        times.append(time.perf_counter() - t0)
+        timer.add("serve_pass", times[-1])
+    times.sort()
+    elapsed = times[len(times) // 2]
+    value = n_jobs / elapsed
+    platform = jax.devices()[0].platform
+    result = {
+        "metric": f"serve jobs/sec @{args.nodes}x{args.trace_len} "
+                  f"x{n_jobs} jobs (async engine, mixed traffic, "
+                  f"1 chip, {platform})",
+        "value": round(value, 2),
+        "unit": "jobs/sec",
+        "vs_baseline": 0.0,
+    }
+    quiet = doc["jobs_quiesced"] == doc["jobs_total"]
+    retired = sum(j["metrics"]["instrs_retired"]
+                  for j in doc["jobs"].values())
+    extra = {
+        "engine": "async",
+        "steps": doc["wave_count"],
+        "retired": retired,
+        "quiescent": quiet,
+        "elapsed_s": round(elapsed, 3),
+        "rep_times_s": [round(t, 3) for t in times],
+        "phases": timer.report(),
+        "serve": {"slots": args.serve_slots, "jobs": n_jobs,
+                  "waves": doc["wave_count"],
+                  "padding_waste": round(doc["padding_waste"], 4)},
+    }
+    print(json.dumps(result))
+    print(json.dumps(extra), file=sys.stderr)
+
+    if args.record:
+        from ue22cs343bb1_openmp_assignment_tpu.obs import (
+            history, roofline)
+        fingerprint = {
+            "engine": "async", "mode": "serve",
+            "workload": "mixed", "nodes": args.nodes,
+            "trace_len": args.trace_len, "chunk": args.chunk,
+            "reps": args.reps, "max_cycles": max_cycles,
+            "slots": args.serve_slots, "jobs": n_jobs,
+            "platform": platform, "smoke": bool(args.smoke),
+        }
+        hist_doc = history.entry(
+            label=f"serve@{args.serve_slots}",
+            source="bench.py",
+            result=result, extra=extra, config=fingerprint,
+            sha=history.git_sha(os.path.dirname(
+                os.path.abspath(__file__))),
+            captured_at=time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            device_kind=roofline.detect_device_kind(),
+            serve=extra["serve"])
+        history.append(args.record, hist_doc)
+        print(f"recorded to {args.record}", file=sys.stderr)
+
+    if not quiet:
+        print(f"error: {doc['jobs_total'] - doc['jobs_quiesced']} "
+              f"job(s) hit the {max_cycles}-cycle budget without "
+              "quiescing — jobs/sec is not a valid headline",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--engine", choices=["sync", "async", "deep"],
@@ -142,6 +245,18 @@ def main():
                     help="capture a jax.profiler trace of one timed run "
                          "into DIR (viewable with TensorBoard/Perfetto; "
                          "SURVEY §5 tracing)")
+    ap.add_argument("--serve", action="store_true",
+                    help="measure the batched serving layer instead of "
+                         "one machine: run the fixed traffic mix "
+                         "through serve.serve() waves and report "
+                         "jobs/sec (serve.py, ROADMAP item 2)")
+    ap.add_argument("--serve-slots", type=int, default=8,
+                    help="batch slots per wave for --serve (default 8; "
+                         "1 = the sequential baseline bench-diff "
+                         "compares against)")
+    ap.add_argument("--serve-jobs", type=int, default=None,
+                    help="jobs in the --serve traffic mix (default "
+                         "2x slots so every slot turns over once)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny config on CPU for smoke testing")
     ap.add_argument("--record", metavar="PATH",
@@ -180,6 +295,12 @@ def main():
 
     if args.smoke:
         args.nodes, args.trace_len, args.chunk = 64, 8, 8
+        if args.serve:
+            # serving smoke: many small tenants, not one 64-node machine
+            args.nodes = 8
+
+    if args.serve:
+        return _serve_bench(args, jax)
 
     sync_like = args.engine in ("sync", "deep")
     if args.txn_width is not None and not sync_like:

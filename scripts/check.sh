@@ -175,6 +175,29 @@ print(f"fused-round smoke: ok (routed ops exact, io-contract "
       f"{bpi:.1f} B/instr < xla 191377.95)")
 PYEOF
 
+# Serve smoke (30s box): 8 mixed-workload jobs packed into 4 slots
+# must all reach quiescence, and one job's batched dump must stay
+# byte-identical to its solo run (the per-tenant bit-parity gate the
+# slow-tier protocol-variant tests check exhaustively).
+timeout -k 5 30 env JAX_PLATFORMS=cpu python - <<'PYEOF'
+import tempfile, pathlib
+from ue22cs343bb1_openmp_assignment_tpu import serve
+specs = serve.mixed_jobs(8, nodes=4, trace_len=8)
+with tempfile.TemporaryDirectory() as td:
+    doc = serve.serve(specs, slots=4, chunk=8, out_dir=td)
+    assert doc["jobs_quiesced"] == 8, doc
+    spec = specs[3]
+    solo = serve.solo_dumps(spec)
+    jdir = pathlib.Path(td) / spec.name
+    got = [(jdir / f"core_{n}_output.txt").read_text()
+           for n in range(spec.nodes)]
+    assert got == solo, f"batched dump != solo for {spec.name}"
+print(f"serve smoke: ok (8/8 jobs quiesced in {doc['wave_count']} "
+      f"waves, {doc['jobs_per_sec']:.0f} jobs/sec, "
+      f"padding_waste={doc['padding_waste']:.3f}, "
+      f"{spec.name} batched dump == solo)")
+PYEOF
+
 if [[ "${1:-}" == "--analyze" ]]; then
     exit 0
 fi

@@ -189,13 +189,28 @@ def test_bench_diff_bytes_cli_rc_matrix(tmp_path, capsys):
     assert rc == 2 and "different device" in out
 
 
-# -- schema v1.2 backcompat ------------------------------------------------
+# -- schema v1.3 backcompat ------------------------------------------------
 
 
-def test_schema_v12_backcompat_matrix():
-    v12 = _entry("x")
-    assert v12["schema"] == "cache-sim/bench/v1.2"
+def test_schema_backcompat_matrix():
+    v13 = _entry("x")
+    assert v13["schema"] == "cache-sim/bench/v1.3"
+    history.validate_entry(v13)
+    # a well-formed serve block rides v1.3
+    served = copy.deepcopy(v13)
+    served["serve"] = {"slots": 8, "jobs": 16, "waves": 2,
+                       "padding_waste": 0.125}
+    history.validate_entry(served)
+    # v1.2: cost allowed, serve NOT
+    v12 = copy.deepcopy(v13)
+    v12["schema"] = "cache-sim/bench/v1.2"
+    del v12["serve"]
     history.validate_entry(v12)
+    v12_bad = copy.deepcopy(v12)
+    v12_bad["serve"] = {"slots": 1, "jobs": 1, "waves": 1,
+                        "padding_waste": 0.0}
+    with pytest.raises(ValueError, match="unknown key: serve"):
+        history.validate_entry(v12_bad)
     # v1.1: comparability keys allowed, cost NOT
     v11 = copy.deepcopy(v12)
     v11["schema"] = "cache-sim/bench/v1.1"
@@ -205,7 +220,7 @@ def test_schema_v12_backcompat_matrix():
     v11_bad["cost"] = {"kernels": {}}
     with pytest.raises(ValueError, match="unknown key: cost"):
         history.validate_entry(v11_bad)
-    # v1: neither generation of optional keys
+    # v1: no generation of optional keys
     v1 = copy.deepcopy(v12)
     v1["schema"] = "cache-sim/bench/v1"
     for k in ("cost", "device_kind", "hlo_fingerprint"):
@@ -215,11 +230,21 @@ def test_schema_v12_backcompat_matrix():
     v1_bad["device_kind"] = "cpu"
     with pytest.raises(ValueError, match="unknown key: device_kind"):
         history.validate_entry(v1_bad)
-    # malformed cost is rejected even on v1.2
-    bad = copy.deepcopy(v12)
+    # malformed cost is rejected even on v1.3
+    bad = copy.deepcopy(v13)
     bad["cost"] = {"bytes_per_instr": -1}
     with pytest.raises(ValueError):
         history.validate_entry(bad)
+    # malformed serve blocks are rejected on v1.3
+    for block in ({"slots": -1, "jobs": 1, "waves": 1,
+                   "padding_waste": 0.0},
+                  {"slots": 1, "jobs": 1, "waves": 1,
+                   "padding_waste": 1.5},
+                  ["not", "a", "dict"]):
+        bad = copy.deepcopy(v13)
+        bad["serve"] = block
+        with pytest.raises(ValueError, match="serve"):
+            history.validate_entry(bad)
 
 
 def test_archived_v1_ingest_still_validates():
@@ -284,6 +309,27 @@ def test_dashboard_roofline_points_from_cost_vector():
     # both artifacts must render the scatter without raising
     assert "roofline" in dashboard.render_html(m)
     assert "| live | step |" in dashboard.render_markdown(m)
+
+
+def test_dashboard_serving_series():
+    serve_e = history.entry(
+        label="serve@8", source="test",
+        result={"metric": "serve jobs/sec", "value": 550.0,
+                "unit": "jobs/sec"},
+        extra={"engine": "async", "rep_times_s": [0.03]},
+        device_kind="cpu",
+        serve={"slots": 8, "jobs": 16, "waves": 2,
+               "padding_waste": 0.125})
+    m = dashboard.build_model(_archive_entries() + [serve_e])
+    assert len(m["serving"]) == 1
+    assert m["serving"][0]["slots"] == 8
+    assert m["serving"][0]["value"] == pytest.approx(550.0)
+    assert "Serving throughput" in dashboard.render_html(m)
+    assert "| serve@8 | 8 |" in dashboard.render_markdown(m)
+    # instrs/sec entries never leak into the serving series
+    m2 = dashboard.build_model(_archive_entries())
+    assert m2["serving"] == []
+    assert "no serving entries" in dashboard.render_markdown(m2)
 
 
 def test_dashboard_golden_render(tmp_path, capsys):

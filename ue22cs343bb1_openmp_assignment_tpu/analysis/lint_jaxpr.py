@@ -174,6 +174,20 @@ def recompile_guard(cfg: Optional[SystemConfig] = None) -> dict:
     f_sync(ss)
     s = f_sync._cache_size()
 
+    # the serving layer's wave step: two waves of HETEROGENEOUS jobs
+    # (different traces, same slot shape) must hit one compilation —
+    # serve.py's admission loop depends on this staying true
+    from ue22cs343bb1_openmp_assignment_tpu import state as state_mod
+    f_wave = jax.jit(lambda b: step.batched_wave(cfg, b, 4, 64))
+    wave1 = state_mod.stack_states(
+        [init_state(cfg, traces), init_state(cfg)])
+    wave2 = state_mod.stack_states(
+        [init_state(cfg, list(reversed(traces))),
+         init_state(cfg, traces)])
+    f_wave(wave1)
+    f_wave(wave2)
+    w = f_wave._cache_size()
+
     # the native build cache is content-hash keyed: a second engine
     # must reuse the compiled library byte-for-byte (same path, no
     # rebuild — the mtime would move if the .so were recompiled)
@@ -186,5 +200,6 @@ def recompile_guard(cfg: Optional[SystemConfig] = None) -> dict:
     del eng1, eng2
 
     return {"async_cache_size": a, "sync_cache_size": s,
+            "wave_cache_size": w,
             "native_build_reused": bool(n_ok),
-            "ok": a == 1 and s == 1 and bool(n_ok)}
+            "ok": a == 1 and s == 1 and w == 1 and bool(n_ok)}

@@ -206,6 +206,7 @@ def run_jaxpr(quiet) -> dict:
         _print(quiet, f"  ! {f['target']}: {f['rule']} — {f['detail']}")
     _print(quiet, f"   recompile guard: async cache={guard['async_cache_size']} "
                   f"sync cache={guard['sync_cache_size']} "
+                  f"wave cache={guard['wave_cache_size']} "
                   f"native reuse={guard['native_build_reused']}")
     return rep
 

@@ -625,6 +625,9 @@ def main(argv=None) -> int:
     if raw[:1] == ["dashboard"]:
         from ue22cs343bb1_openmp_assignment_tpu.obs import cli as obs_cli
         return obs_cli.main_dashboard(raw[1:])
+    if raw[:1] == ["serve"]:
+        from ue22cs343bb1_openmp_assignment_tpu import serve as serve_mod
+        return serve_mod.main(raw[1:])
     args = build_parser().parse_args(raw)
     if args.cpu:
         import jax

@@ -7,7 +7,8 @@ append-only JSONL nobody rendered. This module turns a bench history
 
 - **HTML** — inline-SVG charts, zero external assets, openable from a
   CI artifact tab: the headline instrs/sec trend against the 1e8
-  north-star line (BASELINE.json), the bench-diff verdict strip
+  north-star line (BASELINE.json), the serving jobs/sec trend
+  (``bench.py --serve`` rows, ROADMAP item 2), the bench-diff verdict strip
   (regression/noise/improvement per adjacent pair, obs.regress), the
   per-(protocol x workload) coverage cells as ROADMAP item 4 lands,
   the sharded-parity scaling curve from the multichip dryruns, and the
@@ -63,6 +64,11 @@ def build_model(entries: List[dict],
     bench = [e for e in entries if e.get("unit") == "instrs/sec"]
     multichip = [e for e in entries
                  if (e.get("config") or {}).get("kind") == "multichip"]
+    serving = [{"label": e["label"], "value": float(e["value"]),
+                "slots": (e.get("serve") or {}).get("slots"),
+                "padding_waste": (e.get("serve") or {}).get(
+                    "padding_waste")}
+               for e in entries if e.get("unit") == "jobs/sec"]
     headline = [{"label": e["label"], "value": float(e["value"]),
                  "engine": (e.get("config") or {}).get("engine"),
                  "vs_target": float(e["value"]) / target}
@@ -106,6 +112,7 @@ def build_model(entries: List[dict],
             "cells": {f"{p}/{w}": v
                       for (p, w), v in sorted(cells.items())},
             "roofline": points, "scaling": scaling,
+            "serving": serving,
             "n_entries": len(entries)}
 
 
@@ -288,6 +295,8 @@ td, th {{ border: 1px solid #d5dbdb; padding: 4px 10px;
 <h2>Headline: simulated instrs/sec</h2>
 {_svg_series("headline", model["headline"], "value",
              model["target"], "instrs/sec")}
+<h2>Serving throughput (jobs/sec)</h2>
+{_svg_series("serving", model["serving"], "value", None, "jobs/sec")}
 <h2>bench-diff verdicts (adjacent pairs)</h2>
 {verdict_html}
 <h2>Coverage: protocol &times; workload</h2>
@@ -313,6 +322,19 @@ def render_markdown(model: dict) -> str:
     for h in model["headline"]:
         lines.append(f"| {h['label']} | {h['engine'] or '?'} "
                      f"| {h['value']:.4g} | {h['vs_target']:.2%} |")
+    lines += ["", "## Serving throughput (jobs/sec)", ""]
+    if model["serving"]:
+        lines += ["| entry | slots | jobs/sec | padding waste |",
+                  "|---|---:|---:|---:|"]
+        for s in model["serving"]:
+            slots = "?" if s["slots"] is None else f"{s['slots']}"
+            pw = ("?" if s["padding_waste"] is None
+                  else f"{s['padding_waste']:.1%}")
+            lines.append(f"| {s['label']} | {slots} "
+                         f"| {s['value']:.4g} | {pw} |")
+    else:
+        lines.append("*no serving entries yet (bench.py --serve "
+                     "--record)*")
     lines += ["", "## bench-diff verdicts (adjacent pairs)", ""]
     if model["verdicts"]:
         lines += ["| pair | verdict | delta |", "|---|---|---:|"]

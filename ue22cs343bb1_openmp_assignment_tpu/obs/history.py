@@ -26,12 +26,13 @@ import os
 import subprocess
 from typing import List, Optional
 
-SCHEMA_ID = "cache-sim/bench/v1.2"
+SCHEMA_ID = "cache-sim/bench/v1.3"
 
 #: older schema ids; validate_entry accepts docs under any of these,
 #: with only the optional keys their version introduced
 SCHEMA_V1 = "cache-sim/bench/v1"
 SCHEMA_V11 = "cache-sim/bench/v1.1"
+SCHEMA_V12 = "cache-sim/bench/v1.2"
 
 #: entry keys, all always present (None marks "not captured")
 _TOP_KEYS = ("schema", "label", "source", "captured_at", "git_sha",
@@ -41,10 +42,16 @@ _TOP_KEYS = ("schema", "label", "source", "captured_at", "git_sha",
 
 #: v1.1 added the comparability keys (bench-diff refuses to compare
 #: rep times across devices); v1.2 added the deterministic cost
-#: vector (obs.roofline.cost_vector — the --bytes gate's input).
-#: Optional: absent and None both mean "not captured".
+#: vector (obs.roofline.cost_vector — the --bytes gate's input);
+#: v1.3 added the serving block ({slots, jobs, waves, padding_waste}
+#: from bench.py --serve — the jobs/sec rows next to the instrs/sec
+#: headline). Optional: absent and None both mean "not captured".
 _OPT_KEYS_V11 = ("device_kind", "hlo_fingerprint")
 _OPT_KEYS_V12 = _OPT_KEYS_V11 + ("cost",)
+_OPT_KEYS_V13 = _OPT_KEYS_V12 + ("serve",)
+
+#: required fields of a serve block (ints except padding_waste)
+_SERVE_KEYS = ("slots", "jobs", "waves", "padding_waste")
 
 
 # lint: host
@@ -66,8 +73,9 @@ def entry(label: str, source: str, result: dict, extra: dict,
           captured_at: Optional[str] = None,
           device_kind: Optional[str] = None,
           hlo_fingerprint: Optional[str] = None,
-          cost: Optional[dict] = None) -> dict:
-    """Build a v1.2 entry from bench.py's two JSON lines.
+          cost: Optional[dict] = None,
+          serve: Optional[dict] = None) -> dict:
+    """Build a v1.3 entry from bench.py's two JSON lines.
 
     ``result`` is the stdout line ({metric, value, unit, vs_baseline});
     ``extra`` is the stderr line (engine, rep_times_s, quiescent, ...).
@@ -77,7 +85,9 @@ def entry(label: str, source: str, result: dict, extra: dict,
     gracefully for archived captures. ``device_kind`` /
     ``hlo_fingerprint`` make cross-device comparisons detectable;
     ``cost`` is the deterministic roofline cost vector
-    (obs.roofline.cost_vector) behind ``bench-diff --bytes``.
+    (obs.roofline.cost_vector) behind ``bench-diff --bytes``;
+    ``serve`` is the batched-serving block ({slots, jobs, waves,
+    padding_waste}) attached to jobs/sec rows by ``bench.py --serve``.
     """
     doc = {
         "schema": SCHEMA_ID,
@@ -103,15 +113,16 @@ def entry(label: str, source: str, result: dict, extra: dict,
         "device_kind": device_kind,
         "hlo_fingerprint": hlo_fingerprint,
         "cost": cost,
+        "serve": serve,
     }
     return validate_entry(doc)
 
 
 # lint: host
 def validate_entry(doc: dict) -> dict:
-    """Check an entry against the schema (v1.2, or v1/v1.1 unchanged
-    for backward compatibility — an old doc may only carry the
-    optional keys its version introduced); returns the doc, raises
+    """Check an entry against the schema (v1.3, or v1/v1.1/v1.2
+    unchanged for backward compatibility — an old doc may only carry
+    the optional keys its version introduced); returns the doc, raises
     ValueError listing every violation (same contract as
     obs.schema.validate)."""
     errs = []
@@ -119,7 +130,8 @@ def validate_entry(doc: dict) -> dict:
         raise ValueError(f"entry must be a dict, got {type(doc).__name__}")
     sid = doc.get("schema")
     allowed = _TOP_KEYS + (
-        _OPT_KEYS_V12 if sid == SCHEMA_ID
+        _OPT_KEYS_V13 if sid == SCHEMA_ID
+        else _OPT_KEYS_V12 if sid == SCHEMA_V12
         else _OPT_KEYS_V11 if sid == SCHEMA_V11 else ())
     for k in _TOP_KEYS:
         if k not in doc:
@@ -127,10 +139,10 @@ def validate_entry(doc: dict) -> dict:
     for k in doc:
         if k not in allowed:
             errs.append(f"unknown key: {k}")
-    if sid not in (SCHEMA_ID, SCHEMA_V11, SCHEMA_V1):
+    if sid not in (SCHEMA_ID, SCHEMA_V12, SCHEMA_V11, SCHEMA_V1):
         errs.append(f"schema must be {SCHEMA_ID!r} (or the "
-                    f"backward-compatible {SCHEMA_V11!r}/{SCHEMA_V1!r}),"
-                    f" got {sid!r}")
+                    f"backward-compatible {SCHEMA_V12!r}/{SCHEMA_V11!r}"
+                    f"/{SCHEMA_V1!r}), got {sid!r}")
     for k in _OPT_KEYS_V11:
         v = doc.get(k)
         if v is not None and (not isinstance(v, str) or not v):
@@ -148,6 +160,22 @@ def validate_entry(doc: dict) -> dict:
                     or isinstance(bpi, bool) or bpi < 0):
                 errs.append("cost.bytes_per_instr must be None or a "
                             f"non-negative number, got {bpi!r}")
+    srv = doc.get("serve")
+    if srv is not None:
+        if not isinstance(srv, dict):
+            errs.append("serve must be None or a dict "
+                        f"{{{', '.join(_SERVE_KEYS)}}}")
+        else:
+            for k in ("slots", "jobs", "waves"):
+                x = srv.get(k)
+                if not isinstance(x, int) or isinstance(x, bool) or x < 0:
+                    errs.append(f"serve.{k} must be a non-negative int, "
+                                f"got {x!r}")
+            pw = srv.get("padding_waste")
+            if (not isinstance(pw, (int, float)) or isinstance(pw, bool)
+                    or not 0.0 <= pw <= 1.0):
+                errs.append("serve.padding_waste must be a number in "
+                            f"[0, 1], got {pw!r}")
     for k in ("label", "source", "metric", "unit"):
         if not isinstance(doc.get(k), str) or not doc.get(k):
             errs.append(f"{k} must be a non-empty string")
@@ -310,6 +338,7 @@ def ingest_multichip(path: str, label: Optional[str] = None) -> dict:
         "device_kind": None,
         "hlo_fingerprint": None,
         "cost": None,
+        "serve": None,
     }
     return validate_entry(doc)
 

@@ -554,16 +554,89 @@ def run_to_quiescence(cfg: SystemConfig, state: SimState,
     return _run_quiescence(cfg, state, 1, max_cycles, message_phase)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 2, 3))
+@functools.partial(jax.jit, static_argnums=(0, 2, 3, 4))
 def run_chunked_to_quiescence(cfg: SystemConfig, state: SimState,
                               chunk: int = 32,
-                              max_cycles: int = 100_000) -> SimState:
+                              max_cycles: int = 100_000,
+                              message_phase=None) -> SimState:
     """Quiescence fixpoint with a `chunk`-cycle scan per while iteration.
 
     One device dispatch for the whole run — essential on high-latency
     device links (the axon tunnel makes each eager op a network round
     trip) — and the quiescence reduction amortizes over the chunk. May
     run up to chunk-1 cycles past quiescence or max_cycles (see
-    _run_quiescence).
+    _run_quiescence). ``message_phase`` is `cycle`'s static
+    handler-phase override (protocol-variant solo runs in serve.py).
     """
-    return _run_quiescence(cfg, state, chunk, max_cycles)
+    return _run_quiescence(cfg, state, chunk, max_cycles, message_phase)
+
+
+# -- batched wave runner (serving layer) -----------------------------------
+
+def batched_wave(cfg: SystemConfig, bstate: SimState, chunk: int,
+                 max_cycles: int, message_phase=None) -> SimState:
+    """Run a [B, ...] batch of independent machines to quiescence.
+
+    The serving layer's wave step (serve.py): one vmapped cycle over
+    the job axis inside the same chunked-scan-in-while-loop shape as
+    _run_quiescence, with per-job early-exit masking — each cycle,
+    jobs that are already quiescent (or out of their `max_cycles`
+    budget) keep their OLD state instead of the stepped one. Because a
+    quiescent state is a fixpoint of `cycle` apart from the cycle
+    counters, the mask's only real effect is freezing those counters:
+    every job's final state (cycle count and metrics included) is
+    bit-identical to running it solo, which is the per-job parity gate
+    (tests/test_serve.py). The wave keeps dispatching chunks until
+    every job is done.
+
+    Unjitted on purpose — run_wave_to_quiescence is the production
+    wrapper (donated batch state, one compile per slot shape); the
+    recompile guard (analysis/lint_jaxpr.py) wraps this function in a
+    fresh jit to prove heterogeneous same-shape waves share one trace.
+    """
+    carry0, ro, blanks = _ro_outside(bstate)
+    step_all = jax.vmap(lambda s: cycle(cfg, s, message_phase=message_phase))
+    done_mask = jax.vmap(lambda s: s.quiescent())
+
+    def body(s, _):
+        full = s.replace(**ro)
+        done = done_mask(full) | (full.cycle >= max_cycles)
+        stepped = step_all(full)
+
+        def freeze(old, new):
+            return jnp.where(
+                done.reshape(done.shape + (1,) * (new.ndim - 1)), old, new)
+
+        out = jax.tree.map(freeze, full, stepped)
+        return out.replace(**blanks), None
+
+    def cond(s):
+        full = s.replace(**ro)
+        live = (~done_mask(full)) & (full.cycle < max_cycles)
+        return jnp.any(live)
+
+    def chunk_body(s):
+        s, _ = jax.lax.scan(body, s, None, length=chunk)
+        return s
+
+    final = jax.lax.while_loop(cond, chunk_body, carry0)
+    return final.replace(**ro)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2, 3, 4),
+                   donate_argnums=(1,))
+def run_wave_to_quiescence(cfg: SystemConfig, bstate: SimState,
+                           chunk: int = 32,
+                           max_cycles: int = 100_000,
+                           message_phase=None) -> SimState:
+    """jit-compiled batched_wave with the batch state donated.
+
+    Donation lets XLA reuse the incoming wave's buffers for the
+    outgoing ones (the batch tensor dominates serve memory at large
+    slot shapes), and the static args pin ONE compile per
+    (slot config, chunk, budget, protocol phase) — the serving loop
+    swaps jobs in and out of the same compiled wave indefinitely
+    (guarded by analysis/lint_jaxpr.recompile_guard). The caller must
+    not reuse the donated input batch.
+    """
+    return batched_wave(cfg, bstate, chunk, max_cycles, message_phase)

@@ -1,0 +1,492 @@
+"""Batched multi-tenant serving: pack independent sim jobs into
+fixed-shape waves and run them under one vmap'd stepper.
+
+"Millions of users" for a simulator means thousands of *independent*
+sims in flight (ROADMAP item 2), not one giant sim. This module is the
+front door for that shape: a stream of (config, trace) jobs is packed
+into ``slots`` fixed-shape batch positions, the whole machine state —
+caches, directory, mailboxes, traces, metrics — carries a leading job
+axis (``state.stack_states``), and each *wave* runs every slot to
+quiescence inside a single jitted ``ops.step.run_wave_to_quiescence``
+call. Finished jobs are swapped out between waves and queued jobs
+admitted in place (``state.set_state``), so XLA compiles the wave
+stepper exactly once per (slot shape, protocol) — the recompile guard
+(analysis/lint_jaxpr.py) checks this stays true.
+
+Slot-fit rules
+--------------
+Every job runs inside the *slot* config (``slot_nodes`` x
+``slot_trace_len``), padded:
+
+* trace padding: instructions [job_T, slot_T) are NOPs with
+  ``instr_count`` unchanged, so the frontend never fetches them;
+* node padding: nodes [job_N, slot_N) get ``instr_count == 0`` — born
+  exhausted, they never issue and (being un-referenced by any job
+  address) never receive traffic;
+* address geometry: traces are generated with the JOB's own config, so
+  job addresses/homes are independent of the slot size (the codec packs
+  ``home << block_bits | block``).
+
+The only place slot and job configs disagree observably is the
+invalid-address sentinel (it depends on num_nodes), so extraction
+remaps ``slot_cfg.invalid_address -> job_cfg.invalid_address`` and
+slices the directory bitvec down to the job's word count. That makes a
+padded batched run *bit-identical* per job to running the job solo —
+the parity gate in tests/test_serve.py holds byte-for-byte on the
+golden state dumps.
+
+Early exit: a quiescent state is a fixpoint of ``cycle`` apart from
+the cycle counters, so the wave runner freezes finished slots via a
+where-mask. Finished jobs therefore keep their *exact* solo cycle
+count while stragglers run on.
+
+Padding waste: jobs/sec at a traffic mix can silently hide slot-fit
+loss, so every wave reports ``padding_waste`` — the fraction of the
+slot instruction budget (slots * slot_nodes * slot_trace_len) that is
+padding rather than real job instructions. It lands in the serve
+summary doc and in bench history's ``serve`` block.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.types import Op
+
+SCHEMA_ID = "cache-sim/serve/v1"
+
+#: workloads the serve traffic mix cycles through (all N-generic)
+DEFAULT_MIX = ("uniform", "false_sharing", "producer_consumer", "hotspot")
+
+
+@dataclasses.dataclass(frozen=True)
+class JobSpec:
+    """One serving job: a workload trace under its own machine config."""
+
+    name: str
+    workload: str = "uniform"
+    nodes: int = 4
+    trace_len: int = 8
+    seed: int = 0
+    protocol: str = "mesi"
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "JobSpec":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"job spec has unknown keys {sorted(unknown)}")
+        if "name" not in d:
+            raise ValueError("job spec needs a 'name'")
+        return cls(**d)
+
+
+# lint: host
+def load_jobs(path) -> List[JobSpec]:
+    """Jobs from a .jsonl file (one spec per line) or a directory of
+    .json files (sorted by filename)."""
+    p = pathlib.Path(path)
+    specs: List[JobSpec] = []
+    if p.is_dir():
+        for f in sorted(p.glob("*.json")):
+            specs.append(JobSpec.from_dict(json.loads(f.read_text())))
+    else:
+        for line in p.read_text().splitlines():
+            line = line.strip()
+            if line:
+                specs.append(JobSpec.from_dict(json.loads(line)))
+    if not specs:
+        raise ValueError(f"no jobs found under {path}")
+    return specs
+
+
+def job_config(spec: JobSpec, queue_capacity: int = 64) -> SystemConfig:
+    return SystemConfig.scale(num_nodes=spec.nodes,
+                              max_instrs=spec.trace_len,
+                              queue_capacity=queue_capacity,
+                              protocol=spec.protocol)
+
+
+def slot_config(specs, slot_nodes: Optional[int] = None,
+                slot_trace_len: Optional[int] = None,
+                queue_capacity: int = 64,
+                protocol: str = "mesi") -> SystemConfig:
+    """The fixed batch-slot shape: defaults to the max over the jobs."""
+    n = slot_nodes or max(s.nodes for s in specs)
+    t = slot_trace_len or max(s.trace_len for s in specs)
+    bad = [s.name for s in specs
+           if s.nodes > n or s.trace_len > t]
+    if bad:
+        raise ValueError(f"jobs {bad} exceed slot shape ({n}x{t})")
+    return SystemConfig.scale(num_nodes=n, max_instrs=t,
+                              queue_capacity=queue_capacity,
+                              protocol=protocol)
+
+
+# one phase callable per protocol, cached so the wave jit sees a stable
+# identity across waves (a fresh closure per wave would recompile)
+_PHASE_CACHE: Dict[str, object] = {}
+
+
+def protocol_phase(protocol: str):
+    """message_phase override for a protocol: None for MESI (the
+    handler core *is* MESI); the table-compiled phase otherwise."""
+    if protocol == "mesi":
+        return None
+    if protocol not in _PHASE_CACHE:
+        from ue22cs343bb1_openmp_assignment_tpu.analysis import protocol_table
+        _PHASE_CACHE[protocol] = protocol_table.table_message_phase(
+            protocol_table.TABLES[protocol]())
+    return _PHASE_CACHE[protocol]
+
+
+# trace synthesis is deterministic in the spec, so repeated serve()
+# passes over the same stream (bench reps) re-ingest for free; a real
+# service receives traces as data, so synthesis is not serving cost
+_ARRAYS_CACHE: Dict[JobSpec, tuple] = {}
+
+
+# lint: host
+def build_job_arrays(job_cfg: SystemConfig, spec: JobSpec):
+    """The job's instr arrays at its OWN geometry (host numpy)."""
+    import jax
+    from ue22cs343bb1_openmp_assignment_tpu.models import workloads
+    if spec in _ARRAYS_CACHE:
+        return _ARRAYS_CACHE[spec]
+    if spec.workload not in workloads.GENERATORS:
+        raise ValueError(f"unknown workload {spec.workload!r}")
+    gen = workloads.GENERATORS[spec.workload]
+    op, addr, val, count = gen(jax.random.PRNGKey(spec.seed), job_cfg,
+                               spec.trace_len)
+    arrays = tuple(np.asarray(a) for a in (op, addr, val, count))
+    _ARRAYS_CACHE[spec] = arrays
+    return arrays
+
+
+# lint: host
+def pad_arrays(slot_cfg: SystemConfig, arrays):
+    """Pad (op, addr, val, count) from job geometry to the slot's
+    [slot_N, slot_T]: NOP-fill ops, zero addr/val, zero count on pad
+    nodes (born exhausted — the frontend never fetches for them)."""
+    op, addr, val, count = arrays
+    n, t = op.shape
+    N, T = slot_cfg.num_nodes, slot_cfg.max_instrs
+    opP = np.full((N, T), int(Op.NOP), np.int32)
+    adP = np.zeros((N, T), np.int32)
+    vaP = np.zeros((N, T), np.int32)
+    cnP = np.zeros((N,), np.int32)
+    opP[:n, :t] = op
+    adP[:n, :t] = addr
+    vaP[:n, :t] = val
+    cnP[:n] = count
+    return opP, adP, vaP, cnP
+
+
+# slot-shaped initial states are immutable, so admission can reuse
+# them across waves and passes; keyed by (spec, slot config)
+_STATE_CACHE: Dict[tuple, object] = {}
+
+
+# lint: host
+def build_job_state(slot_cfg: SystemConfig, job_cfg: SystemConfig,
+                    spec: JobSpec):
+    """Slot-shaped SimState carrying the job's (padded) trace."""
+    from ue22cs343bb1_openmp_assignment_tpu import state as st
+    key = (spec, slot_cfg)
+    if key not in _STATE_CACHE:
+        padded = pad_arrays(slot_cfg, build_job_arrays(job_cfg, spec))
+        _STATE_CACHE[key] = st.init_state(slot_cfg, instr_arrays=padded)
+    return _STATE_CACHE[key]
+
+
+# lint: host
+def extract_job_view(slot_cfg: SystemConfig, job_cfg: SystemConfig,
+                     job_state):
+    """Slice a finished slot back down to the job's own geometry.
+
+    Row-slices every per-node plane to the job's num_nodes, remaps the
+    slot invalid-address sentinel to the job's, and trims the directory
+    bitvec to the job's word count. The result formats through
+    utils.golden byte-identically to a solo run of the job."""
+    import jax
+    import types as _types
+    n, W = job_cfg.num_nodes, job_cfg.bitvec_words
+    g = lambda x: np.asarray(jax.device_get(x))
+    ca = g(job_state.cache_addr)[:n]
+    ca = np.where(ca == slot_cfg.invalid_address,
+                  job_cfg.invalid_address, ca).astype(ca.dtype)
+    return _types.SimpleNamespace(
+        memory=g(job_state.memory)[:n],
+        dir_state=g(job_state.dir_state)[:n],
+        dir_bitvec=g(job_state.dir_bitvec)[:n, :, :W],
+        cache_addr=ca,
+        cache_val=g(job_state.cache_val)[:n],
+        cache_state=g(job_state.cache_state)[:n])
+
+
+# lint: host
+def job_dumps(slot_cfg: SystemConfig, job_cfg: SystemConfig,
+              job_state) -> List[str]:
+    """Per-node golden-format state dumps for one extracted job."""
+    from ue22cs343bb1_openmp_assignment_tpu.utils import golden
+    view = extract_job_view(slot_cfg, job_cfg, job_state)
+    return [golden.format_node_dump(d)
+            for d in golden.state_to_dumps(job_cfg, view)]
+
+
+# lint: host
+def job_metrics_doc(job_state) -> dict:
+    """cache-sim/metrics/v1 report for one extracted job slot."""
+    import jax
+    from ue22cs343bb1_openmp_assignment_tpu.obs import schema
+    m = job_state.metrics
+    md = {f: np.asarray(jax.device_get(getattr(m, f))).tolist()
+          for f in m.__dataclass_fields__}
+    return schema.from_async(md, engine="async")
+
+
+# lint: host
+def solo_dumps(spec: JobSpec, chunk: int = 32, max_cycles: int = 100_000,
+               queue_capacity: int = 64) -> List[str]:
+    """Reference: the job run alone at its own geometry (the parity
+    oracle for the batched path)."""
+    from ue22cs343bb1_openmp_assignment_tpu import state as st
+    from ue22cs343bb1_openmp_assignment_tpu.ops import step
+    from ue22cs343bb1_openmp_assignment_tpu.utils import golden
+    cfg = job_config(spec, queue_capacity)
+    s0 = st.init_state(cfg, instr_arrays=build_job_arrays(cfg, spec))
+    final = step.run_chunked_to_quiescence(
+        cfg, s0, chunk, max_cycles, message_phase=protocol_phase(spec.protocol))
+    return [golden.format_node_dump(d)
+            for d in golden.state_to_dumps(cfg, final)]
+
+
+# lint: host
+def _host_quiescent(host) -> np.ndarray:
+    """SimState.quiescent() per batch slot, in numpy over the one
+    host copy the wave loop pulls (no extra device round trips)."""
+    mb_idle = (np.asarray(host.mb_count) == 0).all(axis=-1)
+    no_wait = (~np.asarray(host.waiting).astype(bool)).all(axis=-1)
+    exhausted = (np.asarray(host.instr_idx)
+                 >= np.asarray(host.instr_count) - 1).all(axis=-1)
+    return mb_idle & no_wait & exhausted
+
+
+# lint: host
+def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
+          slot_trace_len: Optional[int] = None, chunk: int = 32,
+          max_cycles: int = 100_000, queue_capacity: int = 64,
+          out_dir=None, quiet: bool = True) -> dict:
+    """Run a stream of jobs through fixed-shape batch waves.
+
+    Jobs are grouped by protocol (each protocol is its own wave
+    sequence — the message phase is a static jit argument). Within a
+    group, the first ``slots`` jobs are stacked into a batch; each wave
+    runs every slot to quiescence (or the cycle budget); finished jobs
+    are extracted and their slots refilled from the queue via
+    ``state.set_state`` — admission never restacks, so the jit cache
+    stays warm.
+
+    Returns the ``cache-sim/serve/v1`` summary doc; per-job results
+    (dumps + metrics docs) are in ``doc["jobs"]`` and, when ``out_dir``
+    is given, streamed to ``<out_dir>/<job>/`` as they finish.
+    """
+    import jax
+
+    from ue22cs343bb1_openmp_assignment_tpu import state as st
+    from ue22cs343bb1_openmp_assignment_tpu.ops import step
+    from ue22cs343bb1_openmp_assignment_tpu.utils import golden
+
+    t_start = time.perf_counter()
+    by_proto: Dict[str, List[JobSpec]] = {}
+    for s in specs:
+        by_proto.setdefault(s.protocol, []).append(s)
+
+    out_path = pathlib.Path(out_dir) if out_dir is not None else None
+    job_docs: Dict[str, dict] = {}
+    waves: List[dict] = []
+    slot_budget_total = 0
+    real_total = 0
+
+    for protocol, queue in by_proto.items():
+        scfg = slot_config(queue, slot_nodes, slot_trace_len,
+                           queue_capacity, protocol)
+        phase = protocol_phase(protocol)
+        N, T = scfg.num_nodes, scfg.max_instrs
+        # dummy slot filler: zero traces = instantly quiescent
+        if ("empty", scfg) not in _STATE_CACHE:
+            _STATE_CACHE[("empty", scfg)] = st.init_state(scfg)
+        empty = _STATE_CACHE[("empty", scfg)]
+        queue = list(queue)
+
+        # slot i currently holds job `occupant[i]` (None = empty dummy)
+        occupant: List[Optional[JobSpec]] = [None] * slots
+        real_by_slot = [0] * slots   # real (unpadded) instrs per slot
+        states = []
+        for i in range(slots):
+            if queue:
+                spec = queue.pop(0)
+                occupant[i] = spec
+                real_by_slot[i] = int(np.sum(build_job_arrays(
+                    job_config(spec, queue_capacity), spec)[3]))
+                states.append(build_job_state(
+                    scfg, job_config(spec, queue_capacity), spec))
+            else:
+                states.append(empty)
+        bstate = st.stack_states(states)
+
+        while any(o is not None for o in occupant):
+            real = sum(real_by_slot)
+            t0 = time.perf_counter()
+            bstate = step.run_wave_to_quiescence(
+                scfg, bstate, chunk, max_cycles, phase)
+            # ONE device->host transfer per wave; per-job extraction
+            # below is numpy slicing on this copy
+            host = jax.device_get(bstate)
+            quiet_mask = _host_quiescent(host)
+            wave_s = time.perf_counter() - t0
+            budget = slots * N * T
+            finished = [o.name for o in occupant if o is not None]
+            waves.append({
+                "protocol": protocol,
+                "jobs": finished,
+                "wall_s": wave_s,
+                "slot_instr_budget": budget,
+                "real_instrs": real,
+                "padding_waste": 1.0 - real / budget,
+            })
+            slot_budget_total += budget
+            real_total += real
+            if not quiet:
+                print(f"serve: wave {len(waves)} [{protocol}] "
+                      f"jobs={len(finished)} wall={wave_s:.3f}s "
+                      f"padding_waste={waves[-1]['padding_waste']:.3f}")
+
+            # every slot resolves per wave: quiescent, or over budget
+            # (recorded as failed and evicted either way)
+            for i, spec in enumerate(occupant):
+                if spec is None:
+                    continue
+                jstate = st.index_state(host, i)
+                jcfg = job_config(spec, queue_capacity)
+                doc = job_metrics_doc(jstate)
+                ok = bool(quiet_mask[i])
+                job_docs[spec.name] = {
+                    "spec": dataclasses.asdict(spec),
+                    "quiesced": ok,
+                    "cycles": int(np.asarray(jstate.cycle)),
+                    "metrics": doc,
+                }
+                if out_path is not None:
+                    jdir = out_path / spec.name
+                    jdir.mkdir(parents=True, exist_ok=True)
+                    view = extract_job_view(scfg, jcfg, jstate)
+                    golden.write_dumps(jcfg, view, jdir)
+                    (jdir / "metrics.json").write_text(
+                        json.dumps(job_docs[spec.name], indent=2) + "\n")
+                # swap out; admit the next queued job into this slot
+                if queue:
+                    nxt = queue.pop(0)
+                    occupant[i] = nxt
+                    real_by_slot[i] = int(np.sum(build_job_arrays(
+                        job_config(nxt, queue_capacity), nxt)[3]))
+                    bstate = st.set_state(bstate, i, build_job_state(
+                        scfg, job_config(nxt, queue_capacity), nxt))
+                else:
+                    # no replacement: leave the finished (quiescent =
+                    # fixpoint) or budget-dead (cycle >= max_cycles =
+                    # masked) state in place — the wave cond ignores
+                    # both, so clearing the slot would be a wasted
+                    # whole-batch update
+                    occupant[i] = None
+                    real_by_slot[i] = 0
+
+    wall = time.perf_counter() - t_start
+    n_jobs = len(job_docs)
+    doc = {
+        "schema": SCHEMA_ID,
+        "slots": slots,
+        "jobs_total": n_jobs,
+        "jobs_quiesced": sum(1 for d in job_docs.values() if d["quiesced"]),
+        "waves": waves,
+        "wave_count": len(waves),
+        "wall_s": wall,
+        "jobs_per_sec": (n_jobs / wall) if wall > 0 else 0.0,
+        "padding_waste": (1.0 - real_total / slot_budget_total
+                          if slot_budget_total else 0.0),
+        "jobs": job_docs,
+    }
+    if out_path is not None:
+        out_path.mkdir(parents=True, exist_ok=True)
+        (out_path / "serve_summary.json").write_text(
+            json.dumps(doc, indent=2) + "\n")
+    return doc
+
+
+# lint: host
+def mixed_jobs(n: int, nodes: int = 4, trace_len: int = 8,
+               protocol: str = "mesi",
+               mix: Tuple[str, ...] = DEFAULT_MIX) -> List[JobSpec]:
+    """The fixed traffic mix: n jobs cycling through ``mix`` workloads
+    with seeds 0..n-1 (the jobs/sec measurement protocol in PERF.md)."""
+    return [JobSpec(name=f"job{i:03d}", workload=mix[i % len(mix)],
+                    nodes=nodes, trace_len=trace_len, seed=i,
+                    protocol=protocol)
+            for i in range(n)]
+
+
+# lint: host
+def main(argv=None) -> int:
+    """``cache-sim serve`` entry point."""
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="cache-sim serve",
+        description="batched multi-tenant serving: run a stream of "
+                    "(config, trace) jobs in fixed-shape waves")
+    ap.add_argument("--jobs", required=True,
+                    help=".jsonl file or directory of .json job specs")
+    ap.add_argument("--slots", type=int, default=4,
+                    help="batch slots per wave (default 4)")
+    ap.add_argument("--slot-nodes", type=int, default=None,
+                    help="slot node count (default: max over jobs)")
+    ap.add_argument("--slot-trace-len", type=int, default=None,
+                    help="slot trace length (default: max over jobs)")
+    ap.add_argument("--queue-capacity", type=int, default=64)
+    ap.add_argument("--chunk", type=int, default=32)
+    ap.add_argument("--max-cycles", type=int, default=100_000)
+    ap.add_argument("--out-dir", default=None,
+                    help="stream per-job dumps + metrics docs here")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full serve summary doc as JSON")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force JAX_PLATFORMS=cpu (set before jax import)")
+    args = ap.parse_args(argv)
+    if args.cpu:
+        import os
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    specs = load_jobs(args.jobs)
+    doc = serve(specs, slots=args.slots, slot_nodes=args.slot_nodes,
+                slot_trace_len=args.slot_trace_len, chunk=args.chunk,
+                max_cycles=args.max_cycles,
+                queue_capacity=args.queue_capacity,
+                out_dir=args.out_dir, quiet=False)
+    if args.json:
+        print(json.dumps(doc, indent=2))
+    else:
+        print(f"serve: {doc['jobs_quiesced']}/{doc['jobs_total']} jobs "
+              f"quiesced in {doc['wave_count']} waves, "
+              f"{doc['jobs_per_sec']:.2f} jobs/sec, "
+              f"padding_waste={doc['padding_waste']:.3f}")
+    return 0 if doc["jobs_quiesced"] == doc["jobs_total"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

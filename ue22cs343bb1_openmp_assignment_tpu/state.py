@@ -300,6 +300,71 @@ def fault_key_from_seed(seed: int) -> jnp.ndarray:
     return jax.random.key_data(jax.random.PRNGKey(seed)).astype(jnp.uint32)
 
 
+# -- batching: a leading job axis over the whole machine -------------------
+#
+# Every SimState leaf keys its minor axes off the node axis (axis 0), so
+# the full machine state — caches, directory, traces, mailboxes, PRNG
+# keys, metrics — batches uniformly under ONE extra leading axis: a
+# [B, ...] pytree of B independent machines. ops.step vmaps the cycle
+# over this axis (the serving layer's wave runner); the helpers below
+# are the only sanctioned way in and out of the batch so slot packing
+# stays a tree-level concern, invisible to the engine.
+
+_stack_states_jit = None
+
+
+def stack_states(states) -> SimState:
+    """Stack per-job SimStates (identical shapes) into one batched
+    pytree with a leading job axis: leaf [..] -> [B, ..].
+
+    Jitted (one program per batch size + shape): a whole-machine state
+    is ~39 leaves, and eager per-leaf stacks cost more than the wave
+    they feed at small node counts."""
+    import jax
+    global _stack_states_jit
+    if _stack_states_jit is None:
+        _stack_states_jit = jax.jit(lambda ss: jax.tree.map(
+            lambda *xs: jnp.stack(xs, axis=0), *ss))
+    return _stack_states_jit(tuple(states))
+
+
+def index_state(bstate: SimState, i) -> SimState:
+    """Slice job `i` back out of a batched state (inverse of
+    stack_states up to device placement)."""
+    import jax
+    return jax.tree.map(lambda x: x[i], bstate)
+
+
+_set_state_jit = None
+
+
+def set_state(bstate: SimState, i, state: SimState) -> SimState:
+    """Return the batched state with slot `i` replaced by `state` —
+    the between-waves admission primitive of the serving layer.
+
+    Jitted with the slot index traced: one compiled program per batch
+    shape covers every slot, and the whole 39-leaf update is a single
+    dispatch instead of one eager scatter per leaf (which dominated a
+    serve pass before)."""
+    import jax
+    global _set_state_jit
+    if _set_state_jit is None:
+        _set_state_jit = jax.jit(
+            lambda b, s, j: jax.tree.map(
+                lambda bb, ss: bb.at[j].set(ss), b, s))
+    return _set_state_jit(bstate, state, jnp.asarray(i, jnp.int32))
+
+
+def batch_size(bstate: SimState) -> int:
+    return bstate.cache_addr.shape[0]
+
+
+def batch_quiescent(bstate: SimState) -> jnp.ndarray:
+    """Per-job quiescence mask [B] of a batched state."""
+    import jax
+    return jax.vmap(lambda s: s.quiescent())(bstate)
+
+
 # -- bitvector helpers (tiled uint32 words; reference used one byte) ------
 
 def bit_get(bv: jnp.ndarray, node) -> jnp.ndarray:
