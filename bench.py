@@ -55,7 +55,8 @@ def _serve_bench(args, jax):
     def run():
         return serve_mod.serve(specs, slots=args.serve_slots,
                                chunk=args.chunk, max_cycles=max_cycles,
-                               queue_capacity=qcap)
+                               queue_capacity=qcap,
+                               devices=args.devices)
 
     from ue22cs343bb1_openmp_assignment_tpu.obs.phases import PhaseTimer
     timer = PhaseTimer()
@@ -72,10 +73,15 @@ def _serve_bench(args, jax):
     elapsed = times[len(times) // 2]
     value = n_jobs / elapsed
     platform = jax.devices()[0].platform
+    # like the slot count, the device count stays OUT of the metric
+    # string: a 1-device and an N-device serve record the same metric,
+    # so bench-diff adjudicates batch-axis sharding as a regular
+    # IMPROVEMENT/REGRESSION verdict (the count rides the serve block
+    # and the fingerprint)
     result = {
         "metric": f"serve jobs/sec @{args.nodes}x{args.trace_len} "
                   f"x{n_jobs} jobs (async engine, mixed traffic, "
-                  f"1 chip, {platform})",
+                  f"{platform})",
         "value": round(value, 2),
         "unit": "jobs/sec",
         "vs_baseline": 0.0,
@@ -93,6 +99,8 @@ def _serve_bench(args, jax):
         "phases": timer.report(),
         "serve": {"slots": args.serve_slots, "jobs": n_jobs,
                   "waves": doc["wave_count"],
+                  "devices": args.devices,
+                  "mb_dropped": doc["mb_dropped"],
                   "padding_waste": round(doc["padding_waste"], 4)},
     }
     print(json.dumps(result))
@@ -107,6 +115,7 @@ def _serve_bench(args, jax):
             "trace_len": args.trace_len, "chunk": args.chunk,
             "reps": args.reps, "max_cycles": max_cycles,
             "slots": args.serve_slots, "jobs": n_jobs,
+            "devices": args.devices,
             "platform": platform, "smoke": bool(args.smoke),
         }
         hist_doc = history.entry(
@@ -257,6 +266,25 @@ def main():
     ap.add_argument("--serve-jobs", type=int, default=None,
                     help="jobs in the --serve traffic mix (default "
                          "2x slots so every slot turns over once)")
+    ap.add_argument("--devices", type=int, default=1,
+                    help="--serve: shard the wave's batch axis over "
+                         "this many local devices (serve.py batch "
+                         "mesh; --serve-slots must divide evenly). "
+                         "The device count stays out of the metric "
+                         "string so bench-diff adjudicates 1-vs-N "
+                         "devices as a verdict")
+    ap.add_argument("--transport", choices=["auto", "all_to_all",
+                                            "rdma"],
+                    default="auto",
+                    help="async engine + --sharded: phase-3 delivery "
+                         "transport (parallel/rdma_comm). all_to_all "
+                         "= lane-bucketed lax.all_to_all router; rdma "
+                         "= Pallas remote-DMA ring (neighbor "
+                         "exchange, send/recv semaphores). auto: "
+                         "rdma on a real TPU backend, else the "
+                         "implicit GSPMD delivery (the CPU Pallas "
+                         "interpreter is parity-grade, not "
+                         "bench-grade)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny config on CPU for smoke testing")
     ap.add_argument("--record", metavar="PATH",
@@ -500,6 +528,47 @@ def main():
         st0 = shard_state(cfg, mesh, st0)
         print(f"sharded: node axis over {n_dev} device(s)",
               file=sys.stderr)
+        if args.engine == "async":
+            from ue22cs343bb1_openmp_assignment_tpu.parallel import (
+                rdma_comm)
+            from ue22cs343bb1_openmp_assignment_tpu.parallel.mesh import (
+                flatten_mesh)
+            want = args.transport
+            if want == "auto":
+                # the CPU Pallas interpreter discharges remote DMAs as
+                # whole-buffer gathers — parity-grade, not bench-grade
+                want = "rdma" if rdma_comm.native() else None
+            if want is not None and n_dev == 1:
+                print("note: --transport needs >1 device (no "
+                      "cross-shard traffic); measuring the implicit "
+                      "GSPMD delivery", file=sys.stderr)
+                want = None
+            if want is not None and not rdma_comm.supported(cfg):
+                print("note: --transport needs drop_prob 0 (the "
+                      "global fault draw is not reproducible "
+                      "per-shard); measuring the implicit GSPMD "
+                      "delivery", file=sys.stderr)
+                want = None
+            if want is not None:
+                import dataclasses
+                cfg = dataclasses.replace(cfg, transport=want)
+                deliver_fn = rdma_comm.make_routed_deliver(
+                    cfg, flatten_mesh(mesh))
+                print(f"transport: {want} routed delivery "
+                      f"({rdma_comm.wire_bytes(cfg, n_dev, transport=want)}"
+                      " bytes on wire per round)", file=sys.stderr)
+
+                def runner(s, _fn=deliver_fn):
+                    return run_chunked_to_quiescence(
+                        cfg, s, args.chunk, max_cycles,
+                        deliver_fn=_fn)
+            args.transport = want or "gspmd"
+        elif args.transport != "auto":
+            print("note: --transport applies to the async engine "
+                  "with --sharded; ignoring", file=sys.stderr)
+    elif args.transport != "auto":
+        print("note: --transport applies to the async engine with "
+              "--sharded; ignoring", file=sys.stderr)
 
     def run():
         return runner(st0)
@@ -633,6 +702,9 @@ def main():
             "max_cycles": max_cycles, "replicas": args.replicas,
             "procedural": bool(args.procedural and sync_like),
             "sharded": bool(args.sharded), "devices": n_dev,
+            "transport": (args.transport
+                          if args.sharded and args.engine == "async"
+                          else None),
             "ledger": bool(args.ledger),
             "platform": jax.devices()[0].platform,
             "smoke": bool(args.smoke),

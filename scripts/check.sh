@@ -44,6 +44,11 @@
 # exact bytes/instr gate over the history the bench smoke just
 # recorded: head vs itself must pass (exit 0) and a synthetic +20%
 # bytes vector must be a regression (exit 4). Both boxed ≤30 s.
+#
+# The rdma smoke (≤30 s, 8 virtual CPU devices) checks the Pallas
+# remote-DMA lane router in interpret mode against the all_to_all
+# router bit-for-bit and gates rdma's bytes-on-wire strictly below
+# all_to_all's at the same config (parallel/rdma_comm.wire_bytes).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -196,6 +201,47 @@ print(f"serve smoke: ok (8/8 jobs quiesced in {doc['wave_count']} "
       f"waves, {doc['jobs_per_sec']:.0f} jobs/sec, "
       f"padding_waste={doc['padding_waste']:.3f}, "
       f"{spec.name} batched dump == solo)")
+PYEOF
+
+# RDMA-transport smoke (30s box): on 8 virtual CPU devices the Pallas
+# remote-DMA ring router (interpret mode — the CPU CI correctness
+# contract, parallel/rdma_comm) must bucket and exchange lanes
+# bit-identically to the all_to_all router, and the rdma wire format
+# must move strictly fewer bytes per round than all_to_all at the
+# same config — the perf-report transport row's gate.
+timeout -k 5 30 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 python - <<'PYEOF'
+import numpy as np
+import jax
+import jax.numpy as jnp
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.parallel import (
+    mesh as pmesh, rdma_comm, shardmap_comm)
+from ue22cs343bb1_openmp_assignment_tpu.types import Msg
+
+assert len(jax.devices()) == 8, jax.devices()
+cfg = SystemConfig.scale(num_nodes=64)
+m = pmesh.make_mesh()
+N, S = cfg.num_nodes, cfg.out_slots
+Fw = 6 + cfg.msg_bitvec_words
+rng = np.random.default_rng(0)
+send = rng.random((N, S)) < 0.7
+ctype = jnp.asarray(np.where(send, rng.integers(1, 8, (N, S)),
+                             int(Msg.NONE)).astype(np.int32))
+recv = jnp.asarray(rng.integers(-1, N + 1, (N, S)).astype(np.int32))
+prio = jnp.asarray(rng.integers(0, N * S, (N, S)).astype(np.int32))
+fields = jnp.asarray(
+    rng.integers(-2**31, 2**31, (N, S, Fw)).astype(np.int32))
+a = shardmap_comm.make_router(cfg, m)(ctype, recv, prio, fields)
+b = rdma_comm.make_rdma_router(cfg, m)(ctype, recv, prio, fields)
+for name, x, y in zip(a._fields, a, b):
+    np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                  err_msg=f"field {name}")
+wa = rdma_comm.wire_bytes(cfg, 8, transport="all_to_all")
+wr = rdma_comm.wire_bytes(cfg, 8, transport="rdma")
+assert wr < wa, (wr, wa)
+print(f"rdma smoke: ok (router bit-identical to all_to_all on 8 "
+      f"devices, wire bytes/round rdma {wr} < all_to_all {wa})")
 PYEOF
 
 if [[ "${1:-}" == "--analyze" ]]; then

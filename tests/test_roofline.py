@@ -6,8 +6,8 @@ shipped (dict / list / None / junk), the full bytes-gate rc matrix
 (pass 0 / synthetic +20% regression 4 / cross-device incomparable 2),
 bench-history schema v1.2 backward compatibility (v1 and v1.1 docs
 still validate, and may NOT smuggle newer keys), the multichip ingest
-(32/32/64/65536/65536 from the archived dryruns), and the dashboard
-golden render from exactly the ten committed captures.
+(32/32/64/65536/65536/1048576 from the archived dryruns), and the
+dashboard golden render from exactly the eleven committed captures.
 """
 
 import copy
@@ -24,7 +24,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 GOLDEN = os.path.join(REPO, "tests", "golden")
 BENCH = [os.path.join(REPO, f"BENCH_r0{i}.json") for i in range(1, 6)]
 MULTI = [os.path.join(REPO, f"MULTICHIP_r0{i}.json")
-         for i in range(1, 6)]
+         for i in range(1, 7)]
 
 
 def run_cli(args, capsys):
@@ -260,7 +260,7 @@ def test_archived_v1_ingest_still_validates():
 def test_ingest_multichip_scaling_ladder():
     vals = [history.ingest_multichip(p) for p in MULTI]
     assert [int(v["value"]) for v in vals] == [32, 32, 64, 65536,
-                                               65536]
+                                               65536, 1048576]
     assert vals[0]["label"] == "mc-r01"
     assert all(v["config"]["kind"] == "multichip" for v in vals)
     assert all(v["rep_times_s"] == [] for v in vals)
@@ -292,7 +292,8 @@ def test_dashboard_model_from_archive():
                                                        rel=0.01)
     assert m["target"] == pytest.approx(1e8)
     assert [int(s["nodes"]) for s in m["scaling"]] == [32, 32, 64,
-                                                       65536, 65536]
+                                                       65536, 65536,
+                                                       1048576]
     verdicts = [v["verdict"] for v in m["verdicts"]]
     assert "noise" in verdicts            # r03 -> r04, PERF.md's call
     assert "mesi/uniform" in m["cells"]

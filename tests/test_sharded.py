@@ -159,3 +159,53 @@ def test_sharded_round_runner_multi_txn_bit_identical():
                     jax.tree_util.tree_leaves(ref)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     se.check_exact_directory(cfg, out)
+
+
+@pytest.mark.slow  # ~2 min single-CPU: two 2^20-node txn machines
+def test_2d_mesh_million_node_txn_rung():
+    """The >=1M-simulated-core rung (dryrun_multichip check 8): a
+    1048576-node sync-txn machine sharded hosts x nodes over the
+    2-D mesh runs 2 rounds bit-identical to the unsharded reference.
+    The deep window stays off — it packs requester ids in 16 bits,
+    capping deep machines at 65536 nodes (config.py) — and the O(N)
+    procedural_state constructor avoids init_state's O(N^2) transient
+    sharer bitvector (2 TB at this N). The ladder below this rung
+    (32 / 64 / 65536) is covered by the fast multihost tests and the
+    driver captures (MULTICHIP_r*.json)."""
+    import dataclasses
+
+    from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+    from ue22cs343bb1_openmp_assignment_tpu.parallel import (
+        make_multihost_mesh, make_sharded_round_runner, shard_state)
+
+    huge = dataclasses.replace(
+        SystemConfig.scale(num_nodes=1048576, drain_depth=4,
+                           txn_width=2),
+        procedural="uniform", max_instrs=1)
+    hst = se.procedural_state(huge, 8, seed=3)
+    ref = se.run_rounds(huge, hst, 2)
+    mesh2 = make_multihost_mesh(num_hosts=2, devices=jax.devices()[:8])
+    sh = shard_state(huge, mesh2, hst)
+    out = make_sharded_round_runner(huge, mesh2, sh, 2)(sh)
+    jax.block_until_ready(out)
+    for i, (x, y) in enumerate(zip(jax.tree.leaves(ref),
+                                   jax.tree.leaves(out))):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=f"leaf {i}")
+
+
+def test_transport_runner_single_device_falls_back():
+    """On a 1-device mesh there is no cross-shard traffic: the
+    transport runner must fall back to the plain delivery path and
+    still match run_cycles bit-for-bit."""
+    from ue22cs343bb1_openmp_assignment_tpu.parallel import (
+        make_transport_runner, shard_state)
+    cfg = SystemConfig.scale(num_nodes=16, queue_capacity=16)
+    sys_ = CoherenceSystem.from_workload(cfg, "uniform", trace_len=4,
+                                         seed=1)
+    ref = run_cycles(cfg, sys_.state, 6)
+    mesh = make_mesh(jax.devices()[:1])
+    st = shard_state(cfg, mesh, sys_.state)
+    out = make_transport_runner(cfg, mesh, st, 6)(st)
+    for x, y in zip(jax.tree.leaves(ref), jax.tree.leaves(out)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))

@@ -114,3 +114,85 @@ def test_lane_cap_truncates_in_priority_order():
         got_p = np.sort(pr[np.asarray(out.valid)
                            & (np.arange(pr.size) % (8 * 4) // 4 == src)])
         np.testing.assert_array_equal(got_p, sent_p)
+
+
+@needs_8
+def test_rdma_router_bit_parity_with_all_to_all():
+    """The Pallas remote-DMA ring router (parallel/rdma_comm, interpret
+    mode on CPU — the CI correctness contract) must reproduce the
+    all_to_all router's lanes bit-for-bit, lossless and truncating."""
+    from ue22cs343bb1_openmp_assignment_tpu.parallel import rdma_comm
+    cfg = SystemConfig.scale(num_nodes=64, queue_capacity=32)
+    mesh = make_mesh(jax.devices()[:8])
+    rng = np.random.default_rng(11)
+    cand = random_candidates(cfg, rng)
+    arb = jnp.asarray(rng.permutation(cfg.num_nodes), jnp.int32)
+    prio = candidate_prio(cfg, arb)
+    fields = pack_fields(cand)
+    for cap in (None, 3):
+        a = make_router(cfg, mesh, lane_cap=cap)(
+            cand.type, cand.recv, prio, fields)
+        b = rdma_comm.make_rdma_router(cfg, mesh, lane_cap=cap)(
+            cand.type, cand.recv, prio, fields)
+        for name, x, y in zip(a._fields, a, b):
+            np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y),
+                err_msg=f"lane_cap={cap} field={name}")
+
+
+@needs_8
+def test_routed_deliver_matches_unsharded_engine():
+    """Both explicit transports, threaded into the async engine's
+    phase-3 delivery (ops.step cycle deliver_fn), must leave every
+    SimState leaf bit-identical to the unsharded reference run."""
+    import dataclasses
+
+    from ue22cs343bb1_openmp_assignment_tpu.models.system import (
+        CoherenceSystem)
+    from ue22cs343bb1_openmp_assignment_tpu.ops import step
+    from ue22cs343bb1_openmp_assignment_tpu.parallel import (
+        make_transport_runner, shard_state)
+    cfg = SystemConfig.scale(num_nodes=64, queue_capacity=32)
+    mesh = make_mesh(jax.devices()[:8])
+    for transport in ("rdma", "all_to_all"):
+        c2 = dataclasses.replace(cfg, transport=transport)
+        sys_ = CoherenceSystem.from_workload(c2, "uniform", trace_len=8,
+                                             seed=3)
+        ref = jax.device_get(step.run_cycles(c2, sys_.state, 16))
+        st = shard_state(c2, mesh, sys_.state)
+        got = jax.device_get(
+            make_transport_runner(c2, mesh, st, 16)(st))
+        for i, (x, y) in enumerate(zip(jax.tree.leaves(ref),
+                                       jax.tree.leaves(got))):
+            np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y),
+                err_msg=f"{transport} leaf {i}")
+
+
+def test_wire_bytes_rdma_strictly_fewer():
+    """The rdma wire format (validity via the receiver column's -1
+    sentinel) must move strictly fewer bytes per round than the
+    all_to_all format (separate valid plane) at any config."""
+    from ue22cs343bb1_openmp_assignment_tpu.parallel import rdma_comm
+    for nodes, shards in ((64, 8), (256, 8), (64, 2)):
+        cfg = SystemConfig.scale(num_nodes=nodes)
+        a = rdma_comm.wire_bytes(cfg, shards, transport="all_to_all")
+        r = rdma_comm.wire_bytes(cfg, shards, transport="rdma")
+        assert r < a, (nodes, shards, r, a)
+    with pytest.raises(ValueError):
+        rdma_comm.wire_bytes(SystemConfig.scale(num_nodes=64), 7)
+
+
+def test_routed_deliver_requires_zero_drop_prob():
+    """The fault plane draws one global bernoulli per message slot in
+    delivery order; that order is irreproducible per-shard, so the
+    routed transports refuse configs with drop_prob > 0."""
+    import dataclasses
+
+    from ue22cs343bb1_openmp_assignment_tpu.parallel import rdma_comm
+    cfg = dataclasses.replace(SystemConfig.scale(num_nodes=64),
+                              drop_prob=0.25)
+    assert not rdma_comm.supported(cfg)
+    mesh = make_mesh(jax.devices()[:1])
+    with pytest.raises(ValueError, match="drop_prob"):
+        rdma_comm.make_routed_deliver(cfg, mesh)

@@ -201,6 +201,9 @@ def _v1_doc():
          "upgrades": 0, "conflicts": 0, "evictions": 0,
          "invalidations": 0, "promotions": 0})
     doc["schema"] = schema.SCHEMA_V1
+    # a genuine v1 doc predates the v1.2 mb_dropped key from_sync now
+    # emits — drop it so the fixture stays a faithful old-schema doc
+    doc.pop("mb_dropped", None)
     return doc
 
 
@@ -219,6 +222,7 @@ def test_schema_v1_rejects_txn_latency():
 def test_schema_v11_txn_latency_validated():
     good = _v1_doc()
     good["schema"] = schema.SCHEMA_ID
+    good["mb_dropped"] = 0      # required again at the current schema
     good["txn_latency"] = {
         "spans": 2, "open": 1,
         "by_type": {"read_miss": {"count": 2, "p50": 3, "p95": 5,

@@ -98,6 +98,7 @@ def _targets(cfg: SystemConfig) -> dict:
         "step.run_to_quiescence":
             lambda s: step.run_to_quiescence(cfg, s, 64),
         "pallas_round.routed_ops": lambda s: _routed_ops_probe(),
+        "rdma_comm.route": lambda s: _rdma_route_probe(),
     }
 
 
@@ -127,6 +128,31 @@ def _routed_ops_probe():
             ix.scatter_rows(mat, idx, rows),
             ix.scatter_col(mat, idx, 2, rows[:, 0]),
             ix.scatter_min(dest, idx, rows[:, 0] + 41))
+
+
+def _rdma_route_probe():
+    """Trace the RDMA lane router (parallel/rdma_comm) on a 1-device
+    mesh with the Pallas ring in interpret mode: the shard_map +
+    pallas_call sub-jaxprs recurse into the audit, so the bucketing
+    sort, the wire pack/unpack and the kernel body all face the same
+    budget/host-callback/widening rules as the engine hot path.  One
+    device means the ring body is just the local self-copy (the D - 1
+    remote steps unroll per mesh size and are exercised by the parity
+    tests, not the IR lint), which keeps the probe backend-neutral."""
+    import jax.numpy as jnp
+
+    from ue22cs343bb1_openmp_assignment_tpu.parallel import (
+        mesh as pmesh, rdma_comm)
+
+    cfg = SystemConfig.scale(num_nodes=8)
+    mesh = pmesh.make_mesh(jax.devices()[:1])
+    router = rdma_comm.make_rdma_router(cfg, mesh, interpret=True)
+    N, S, Fw = cfg.num_nodes, cfg.out_slots, 6 + cfg.msg_bitvec_words
+    ctype = jnp.ones((N, S), jnp.int32)
+    recv = jnp.tile(jnp.arange(N, dtype=jnp.int32)[:, None], (1, S))
+    prio = jnp.arange(N * S, dtype=jnp.int32).reshape(N, S)
+    fields = jnp.zeros((N, S, Fw), jnp.int32)
+    return router(ctype, recv, prio, fields)
 
 
 def lint(cfg: Optional[SystemConfig] = None,

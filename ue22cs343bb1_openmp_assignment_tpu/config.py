@@ -182,6 +182,19 @@ class SystemConfig:
     # None = reference semantics (no gating).
     admission_window: int | None = None
 
+    # Cross-shard mailbox transport for the sharded engines
+    # (parallel/). 'all_to_all' is the shard_map + jax.lax.all_to_all
+    # router (parallel/shardmap_comm.py); 'rdma' delivers lanes with a
+    # Pallas remote-DMA ring kernel (parallel/rdma_comm.py,
+    # pltpu.make_async_remote_copy with send/recv semaphores) that
+    # never materializes the full [D*D] exchange tensor. Gated like
+    # fused_round: rdma_comm.supported() decides whether the kernel
+    # compiles natively (real TPU) or runs under the Pallas
+    # interpreter (CPU CI — the correctness contract); unsupported
+    # configs fall back to all_to_all. Single-device meshes bypass the
+    # transport entirely.
+    transport: str = "all_to_all"
+
     def __post_init__(self):
         if self.num_nodes < 1:
             raise ValueError("num_nodes must be >= 1")
@@ -213,6 +226,8 @@ class SystemConfig:
                 "the matches-nobody sentinel (ops/deep_engine)")
         if self.protocol not in ("mesi", "moesi", "mesif"):
             raise ValueError(f"bad protocol {self.protocol!r}")
+        if self.transport not in ("all_to_all", "rdma"):
+            raise ValueError(f"bad transport {self.transport!r}")
         if self.inv_mode not in ("mailbox", "scatter"):
             raise ValueError(f"bad inv_mode {self.inv_mode!r}")
         if self.inv_mode == "mailbox" and self.num_nodes > 64:

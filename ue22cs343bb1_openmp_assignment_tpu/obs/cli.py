@@ -732,6 +732,12 @@ def build_perfreport_parser() -> argparse.ArgumentParser:
                    help="classify against this device kind's peaks "
                         "instead of the detected one (obs.roofline "
                         "static table)")
+    p.add_argument("--shards", type=int, default=None,
+                   help="async engine: shard count for the per-"
+                        "transport bytes-on-wire row (all_to_all vs "
+                        "rdma lane exchange, parallel.rdma_comm."
+                        "wire_bytes). Default: the attached device "
+                        "count when >1, else 8; must divide --nodes")
     p.add_argument("--json", action="store_true",
                    help="emit the full cache-sim/perfreport/v1 doc")
     p.add_argument("--out", metavar="PATH",
@@ -866,6 +872,21 @@ def cmd_perfreport(args) -> int:
          "pallas": bool(getattr(cfg, "pallas_burst", False))},
         records(), per_step_name, steps, retired,
         device_kind=args.device_kind)
+    if args.engine == "async":
+        # the per-transport bytes-on-wire row (deterministic shape
+        # arithmetic, parallel.rdma_comm.wire_bytes) — a sibling
+        # section of the kernel table, NOT a kernel record: transports
+        # move interconnect bytes, not HBM bytes
+        n_sh = args.shards
+        if n_sh is None:
+            n_dev = len(jax.devices())
+            n_sh = n_dev if n_dev > 1 else 8
+        if args.nodes % n_sh:
+            print(f"note: --nodes {args.nodes} does not shard over "
+                  f"{n_sh} devices; omitting the transport row",
+                  file=sys.stderr)
+        else:
+            doc["transport"] = roofline.transport_section(cfg, n_sh)
     fused = next((k for k in doc["kernels"]
                   if k.get("basis") == "io-contract"), None)
     if fused is not None and doc["cost_available"]:

@@ -50,8 +50,12 @@ _OPT_KEYS_V11 = ("device_kind", "hlo_fingerprint")
 _OPT_KEYS_V12 = _OPT_KEYS_V11 + ("cost",)
 _OPT_KEYS_V13 = _OPT_KEYS_V12 + ("serve",)
 
-#: required fields of a serve block (ints except padding_waste)
+#: required fields of a serve block (ints except padding_waste);
+#: optional extras "devices" (batch-mesh width of the wave) and
+#: "mb_dropped" (summed mailbox overflow drops, quirk 6) ride the
+#: same block — absent in pre-multi-device captures, no schema bump
 _SERVE_KEYS = ("slots", "jobs", "waves", "padding_waste")
+_SERVE_OPT_KEYS = ("devices", "mb_dropped")
 
 
 # lint: host
@@ -176,6 +180,12 @@ def validate_entry(doc: dict) -> dict:
                     or not 0.0 <= pw <= 1.0):
                 errs.append("serve.padding_waste must be a number in "
                             f"[0, 1], got {pw!r}")
+            for k in _SERVE_OPT_KEYS:
+                x = srv.get(k)
+                if x is not None and (not isinstance(x, int)
+                                      or isinstance(x, bool) or x < 0):
+                    errs.append(f"serve.{k} must be None or a "
+                                f"non-negative int, got {x!r}")
     for k in ("label", "source", "metric", "unit"):
         if not isinstance(doc.get(k), str) or not doc.get(k):
             errs.append(f"{k} must be a non-empty string")

@@ -353,6 +353,31 @@ def timing_section(phases: dict, kernels: list, steps: int,
     return out
 
 
+# lint: host
+def transport_section(cfg, n_shards: int,
+                      lane_cap: Optional[int] = None) -> dict:
+    """Per-transport bytes-on-wire row for the async engine's sharded
+    delivery (parallel.rdma_comm.wire_bytes — pure shape arithmetic,
+    deterministic per config). NOT a kernel record: the transports move
+    interconnect bytes, not HBM bytes, so the row lives beside the
+    roofline table instead of inside it (and must never carry an
+    io-contract basis — cmd_perfreport's fused lookup keys on that).
+    """
+    from ue22cs343bb1_openmp_assignment_tpu.parallel import rdma_comm
+    per = {t: rdma_comm.wire_bytes(cfg, n_shards, lane_cap, transport=t)
+           for t in ("all_to_all", "rdma")}
+    L = cfg.num_nodes // n_shards
+    return {
+        "basis": "wire-shape",
+        "n_shards": int(n_shards),
+        "lane_cap": int(lane_cap if lane_cap is not None
+                        else L * cfg.out_slots),
+        "bytes_per_round": per,
+        "rdma_strictly_fewer": bool(per["rdma"] < per["all_to_all"]),
+        "savings_frac": round(1.0 - per["rdma"] / per["all_to_all"], 4),
+    }
+
+
 _BOUND_TEXT = {"hbm": "HBM-bound", "compute": "compute-bound",
                "cost_unavailable": "cost unavailable"}
 
@@ -406,6 +431,18 @@ def render_text(doc: dict) -> str:
             f"{f['bytes_per_instr']:.2f} vs xla-cost-model "
             f"{f['unfused_bytes_per_instr']:.2f} "
             f"({ratio:,.0f}x less HBM traffic)")
+    tr = doc.get("transport")
+    if tr:
+        per = tr["bytes_per_round"]
+        verdict = ("rdma moves strictly fewer bytes"
+                   if tr["rdma_strictly_fewer"] else
+                   "WARNING: rdma does NOT move fewer bytes")
+        lines.append("")
+        lines.append(
+            f"  transport ({tr['basis']}, {tr['n_shards']} shards, "
+            f"lane cap {tr['lane_cap']}): bytes on wire per round — "
+            f"all_to_all {per['all_to_all']:,} vs rdma {per['rdma']:,} "
+            f"({100 * tr['savings_frac']:.1f}% less; {verdict})")
     t = doc.get("timing")
     if t:
         lines.append("")

@@ -1,4 +1,4 @@
-"""The unified cross-engine metrics schema: ``cache-sim/metrics/v1.1``.
+"""The unified cross-engine metrics schema: ``cache-sim/metrics/v1.2``.
 
 Before this module each engine's ``--metrics`` dump had its own shape
 (async: the raw Metrics pytree, sync: a hand-picked field subset,
@@ -14,7 +14,7 @@ the producing engine does not measure — *not* zero):
 ==================== ====================================================
 key                  meaning
 ==================== ====================================================
-schema               literal ``"cache-sim/metrics/v1.1"``
+schema               literal ``"cache-sim/metrics/v1.2"``
 engine               producing engine (``async``/``sync``/``deep``/
                      ``native``)
 steps                engine time steps executed
@@ -36,6 +36,8 @@ txn_latency          *optional* (v1.1): transaction-span latency summary
                      {spans, open, by_type: {type: {count, p50, p95,
                      p99}}, segments_total} — async engine with the
                      message ledger on (``cache-sim stats --txns``)
+mb_dropped           (v1.2) mailbox-overflow silent-drop counter, quirk
+                     6 surfaced at top level; ``None`` = not measured
 ==================== ====================================================
 
 The eight core counters stay flat at top level on purpose: pre-existing
@@ -46,6 +48,14 @@ v1 → v1.1: the only change is the optional ``txn_latency`` block.
 :func:`validate` accepts v1 documents unchanged (a v1 doc carrying
 ``txn_latency`` is rejected — the key did not exist in v1), so every
 archived report and golden keeps validating.
+
+v1.1 → v1.2: adds the required top-level ``mb_dropped`` counter — the
+mailbox-overflow silent drop (SURVEY quirk 6, ``assignment.c:754-762``)
+pulled up from ``messages.dropped_overflow`` so drop-sensitive
+consumers (``serve``'s per-wave loud warning, dashboards) read it
+without digging into the messages block. ``None`` for engines with no
+message plane (sync). Older docs validate unchanged: the key is
+required only at v1.2 and rejected below it.
 """
 
 from __future__ import annotations
@@ -54,10 +64,11 @@ from typing import Optional
 
 from ue22cs343bb1_openmp_assignment_tpu.types import MSG_NAMES
 
-SCHEMA_ID = "cache-sim/metrics/v1.1"
+SCHEMA_ID = "cache-sim/metrics/v1.2"
 
-#: the previous schema id; validate() accepts docs under either
+#: previous schema ids; validate() accepts docs under any of them
 SCHEMA_V1 = "cache-sim/metrics/v1"
+SCHEMA_V1_1 = "cache-sim/metrics/v1.1"
 
 #: the eight cross-engine core counters, flat at top level of the report
 CORE_COUNTERS = ("instrs_retired", "read_hits", "write_hits",
@@ -82,7 +93,8 @@ def _report(engine: str, steps: int, step_unit: str, counters: dict,
             messages: Optional[dict] = None,
             queue_depth_peak: Optional[int] = None,
             latency_cycles: Optional[dict] = None,
-            extra: Optional[dict] = None) -> dict:
+            extra: Optional[dict] = None,
+            mb_dropped: Optional[int] = None) -> dict:
     doc = {"schema": SCHEMA_ID, "engine": engine, "steps": int(steps),
            "step_unit": step_unit}
     for k in CORE_COUNTERS:
@@ -92,6 +104,7 @@ def _report(engine: str, steps: int, step_unit: str, counters: dict,
     doc["queue_depth_peak"] = queue_depth_peak
     doc["latency_cycles"] = latency_cycles
     doc["extra"] = extra or {}
+    doc["mb_dropped"] = mb_dropped
     return doc
 
 
@@ -119,7 +132,8 @@ def from_async(m: dict, engine: str = "async") -> dict:
                   "dropped_overflow": int(m["msgs_dropped"]),
                   "dropped_injected": int(m["msgs_injected_dropped"])},
         queue_depth_peak=int(m["mb_depth_peak"]),
-        latency_cycles=latency_histogram(m["lat_hist"]))
+        latency_cycles=latency_histogram(m["lat_hist"]),
+        mb_dropped=int(m["msgs_dropped"]))
 
 
 # lint: host
@@ -143,7 +157,8 @@ def from_native(m: dict, engine: str = "native") -> dict:
         engine, m["cycles"], "cycles", m,
         messages={"processed_total": None, "by_type": None,
                   "dropped_overflow": int(m["msgs_dropped"]),
-                  "dropped_injected": None})
+                  "dropped_injected": None},
+        mb_dropped=int(m["msgs_dropped"]))
 
 
 # lint: host
@@ -195,27 +210,38 @@ def _validate_txn_latency(tl, errs) -> None:
 
 # lint: host
 def validate(doc: dict) -> dict:
-    """Check a report against the schema (v1.1, or v1 unchanged for
-    backward compatibility); returns the doc, raises ValueError
+    """Check a report against the schema (v1.2, or v1/v1.1 unchanged
+    for backward compatibility); returns the doc, raises ValueError
     listing every violation. Dependency-free on purpose — the
     container has no jsonschema."""
     errs = []
     if not isinstance(doc, dict):
         raise ValueError(f"report must be a dict, got {type(doc).__name__}")
     is_v1 = doc.get("schema") == SCHEMA_V1
-    allowed = _TOP_KEYS if is_v1 else _TOP_KEYS + _OPT_KEYS
-    for k in _TOP_KEYS:
+    is_v11 = doc.get("schema") == SCHEMA_V1_1
+    required = _TOP_KEYS if (is_v1 or is_v11) else (
+        _TOP_KEYS + ("mb_dropped",))
+    allowed = (_TOP_KEYS if is_v1
+               else _TOP_KEYS + _OPT_KEYS if is_v11
+               else _TOP_KEYS + _OPT_KEYS + ("mb_dropped",))
+    for k in required:
         if k not in doc:
             errs.append(f"missing key: {k}")
     for k in doc:
         if k not in allowed:
             errs.append(f"unknown key: {k}")
-    if doc.get("schema") not in (SCHEMA_ID, SCHEMA_V1):
+    if doc.get("schema") not in (SCHEMA_ID, SCHEMA_V1, SCHEMA_V1_1):
         errs.append(f"schema must be {SCHEMA_ID!r} (or the "
-                    f"backward-compatible {SCHEMA_V1!r}), "
+                    f"backward-compatible {SCHEMA_V1!r}/{SCHEMA_V1_1!r}), "
                     f"got {doc.get('schema')!r}")
     if "txn_latency" in doc and not is_v1:
         _validate_txn_latency(doc["txn_latency"], errs)
+    if "mb_dropped" in doc:
+        v = doc["mb_dropped"]
+        if v is not None and (not isinstance(v, int)
+                              or isinstance(v, bool) or v < 0):
+            errs.append(f"mb_dropped must be None or a non-negative "
+                        f"int, got {v!r}")
     if not isinstance(doc.get("engine"), str):
         errs.append("engine must be a string")
     if doc.get("step_unit") not in ("cycles", "rounds"):

@@ -56,7 +56,8 @@ LEDGER_FIELDS = ("deq_has", "deq_sender", "deq_type", "deq_addr",
 
 def cycle(cfg: SystemConfig, state: SimState,
           with_events: bool = False, message_phase=None,
-          with_telemetry: bool = False, with_ledger: bool = False):
+          with_telemetry: bool = False, with_ledger: bool = False,
+          deliver_fn=None):
     """Advance the whole machine by one cycle.
 
     Cross-sender arbitration order for this cycle's deliveries comes from
@@ -91,6 +92,12 @@ def cycle(cfg: SystemConfig, state: SimState,
     stacks it in the same single dispatch; obs/txntrace.py reconstructs
     causal transaction spans from it host-side. Output order with every
     capture on: ``(state, events, telem, ledger)``.
+
+    ``deliver_fn`` overrides phase-3 delivery (same signature and
+    return contract as ``mailbox.deliver``, minus ``with_accept``).
+    The sharded transports (parallel/rdma_comm.make_routed_deliver)
+    use this to route enqueue candidates across shards before a
+    shard-local enqueue; single-device callers leave it None.
     """
     if message_phase is None:
         message_phase = handlers.message_phase
@@ -235,9 +242,14 @@ def cycle(cfg: SystemConfig, state: SimState,
         bitvec=c_bitvec)
 
     # ---- phase 3: delivery -----------------------------------------------
-    mb_upd, dropped, injected = mailbox.deliver(cfg, state, cand, arb_rank,
-                                                new_head, new_count,
-                                                with_accept=with_ledger)
+    if deliver_fn is not None:
+        mb_upd, dropped, injected = deliver_fn(cfg, state, cand, arb_rank,
+                                               new_head, new_count)
+    else:
+        mb_upd, dropped, injected = mailbox.deliver(cfg, state, cand,
+                                                    arb_rank,
+                                                    new_head, new_count,
+                                                    with_accept=with_ledger)
     enq_accept = mb_upd.pop("enq_accept", None)
 
     # Vectorized INV application (scale path; reference assumes INV never
@@ -512,7 +524,8 @@ def run_cycles(cfg: SystemConfig, state: SimState,
 
 
 def _run_quiescence(cfg: SystemConfig, state: SimState, chunk: int,
-                    max_cycles: int, message_phase=None) -> SimState:
+                    max_cycles: int, message_phase=None,
+                    deliver_fn=None) -> SimState:
     """while(not quiescent and cycle < max_cycles): scan `chunk` cycles.
 
     The termination predicate runs once per chunk, so a run may exceed
@@ -525,7 +538,8 @@ def _run_quiescence(cfg: SystemConfig, state: SimState, chunk: int,
     carry0, ro, blanks = _ro_outside(state)
 
     def body(s, _):
-        out = cycle(cfg, s.replace(**ro), message_phase=message_phase)
+        out = cycle(cfg, s.replace(**ro), message_phase=message_phase,
+                    deliver_fn=deliver_fn)
         return out.replace(**blanks), None
 
     def cond(s):
@@ -554,11 +568,12 @@ def run_to_quiescence(cfg: SystemConfig, state: SimState,
     return _run_quiescence(cfg, state, 1, max_cycles, message_phase)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 2, 3, 4))
+@functools.partial(jax.jit, static_argnums=(0, 2, 3, 4, 5))
 def run_chunked_to_quiescence(cfg: SystemConfig, state: SimState,
                               chunk: int = 32,
                               max_cycles: int = 100_000,
-                              message_phase=None) -> SimState:
+                              message_phase=None,
+                              deliver_fn=None) -> SimState:
     """Quiescence fixpoint with a `chunk`-cycle scan per while iteration.
 
     One device dispatch for the whole run — essential on high-latency
@@ -566,9 +581,13 @@ def run_chunked_to_quiescence(cfg: SystemConfig, state: SimState,
     trip) — and the quiescence reduction amortizes over the chunk. May
     run up to chunk-1 cycles past quiescence or max_cycles (see
     _run_quiescence). ``message_phase`` is `cycle`'s static
-    handler-phase override (protocol-variant solo runs in serve.py).
+    handler-phase override (protocol-variant solo runs in serve.py);
+    ``deliver_fn`` is its static phase-3 delivery override (the
+    explicit sharded transports, parallel/rdma_comm) — both hash by
+    identity, so callers must build them once per config.
     """
-    return _run_quiescence(cfg, state, chunk, max_cycles, message_phase)
+    return _run_quiescence(cfg, state, chunk, max_cycles, message_phase,
+                           deliver_fn)
 
 
 # -- batched wave runner (serving layer) -----------------------------------

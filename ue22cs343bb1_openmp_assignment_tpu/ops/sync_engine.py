@@ -156,27 +156,33 @@ class SyncState(struct.PyTreeNode):
         return jnp.all(self.idx >= self.instr_count)
 
 
+def _fresh_dm(cfg: SystemConfig, memory: jnp.ndarray) -> jnp.ndarray:
+    """Cold flat directory rows: every entry Unowned with `memory`'s
+    image in DM_MEM. Fresh machines start at round 0; pre-stamp DM_ACT
+    with an impossible round tag so round 0 sees no stale actions, and
+    the claim column above every reachable key."""
+    N, M = cfg.num_nodes, cfg.mem_size
+    S = 1 << cfg.block_bits          # row stride per home (>= M)
+    dm = jnp.zeros((N * S, DM_COLS), jnp.int32)
+    dm = dm.at[:, DM_STATE].set(jnp.full((N * S,), int(DirState.U),
+                                         jnp.int32))
+    dm = dm.at[:, DM_ACT].set(jnp.full((N * S,), -4, jnp.int32))
+    dm = dm.at[:, DM_CLAIM].set(
+        jnp.full((N * S,), jnp.iinfo(jnp.int32).max, jnp.int32))
+    node_rows = jnp.arange(N, dtype=jnp.int32)[:, None] * S
+    blocks = jnp.arange(M, dtype=jnp.int32)[None, :]
+    return dm.at[(node_rows + blocks).reshape(-1), DM_MEM].set(
+        memory.reshape(N * M))
+
+
 def from_sim_state(cfg: SystemConfig, st: SimState, seed: int = 0) -> SyncState:
     """Adopt a freshly initialized SimState (same loaders/workloads).
 
     Must be called on a pre-run state (empty mailboxes, cold caches):
     the engines share initial conditions, not mid-flight state.
     """
-    N, M = cfg.num_nodes, cfg.mem_size
-    S = 1 << cfg.block_bits          # row stride per home (>= M)
-    dm = jnp.zeros((N * S, DM_COLS), jnp.int32)
-    dm = dm.at[:, DM_STATE].set(jnp.full((N * S,), int(DirState.U),
-                                         jnp.int32))
-    # fresh machines start at round 0; pre-stamp DM_ACT with an
-    # impossible round tag so round 0 sees no stale actions, and the
-    # claim column above every reachable key
-    dm = dm.at[:, DM_ACT].set(jnp.full((N * S,), -4, jnp.int32))
-    dm = dm.at[:, DM_CLAIM].set(
-        jnp.full((N * S,), jnp.iinfo(jnp.int32).max, jnp.int32))
-    node_rows = jnp.arange(N, dtype=jnp.int32)[:, None] * S
-    blocks = jnp.arange(M, dtype=jnp.int32)[None, :]
-    dm = dm.at[(node_rows + blocks).reshape(-1), DM_MEM].set(
-        st.memory.reshape(N * M))
+    N = cfg.num_nodes
+    dm = _fresh_dm(cfg, st.memory)
     return SyncState(
         cache_addr=st.cache_addr, cache_val=st.cache_val,
         cache_state=st.cache_state,
@@ -380,15 +386,33 @@ def procedural_state(cfg: SystemConfig, length: int,
     """A SyncState whose instructions come from cfg.procedural —
     `length` instructions per node with O(1) trace storage (the
     instr_pack placeholder has one slot; round_step never reads it in
-    procedural mode). `length` may far exceed cfg.max_instrs."""
+    procedural mode). `length` may far exceed cfg.max_instrs.
+
+    Built directly in the flat dm layout rather than via
+    ``from_sim_state(init_state(cfg))``: init_state materializes the
+    [N, M, ceil(N/32)] sharer bitvector that the flat layout never
+    reads — an O(N^2) *transient* that is 2 TB at the 2^20-node rung.
+    Procedural machines stay O(N) end to end."""
     if not cfg.procedural:
         raise ValueError("cfg.procedural must name a generator")
-    N = cfg.num_nodes
-    from ue22cs343bb1_openmp_assignment_tpu.state import init_state
-    base = from_sim_state(cfg, init_state(cfg), seed=seed)
-    return base.replace(
+    N, C, M = cfg.num_nodes, cfg.cache_size, cfg.mem_size
+    # initializeProcessor's memory image (assignment.c:806-851), the
+    # same cold machine state.init_state builds
+    memory = (20 * jnp.arange(N, dtype=jnp.int32)[:, None]
+              + jnp.arange(M, dtype=jnp.int32)[None, :]) & 0xFF
+    return SyncState(
+        cache_addr=jnp.full((N, C), cfg.invalid_address, jnp.int32),
+        cache_val=jnp.zeros((N, C), jnp.int32),
+        cache_state=jnp.full((N, C), int(CacheState.INVALID), jnp.int32),
+        dm=_fresh_dm(cfg, memory),
         instr_pack=jnp.zeros((N, 1, 2), jnp.int32),
-        instr_count=jnp.full((N,), int(length), jnp.int32))
+        instr_count=jnp.full((N,), int(length), jnp.int32),
+        idx=jnp.zeros((N,), jnp.int32),
+        horizon=jnp.full((N,), 1 << 20, jnp.int32),
+        seed=jnp.asarray(seed, jnp.int32),
+        round=jnp.zeros((), jnp.int32),
+        metrics=SyncMetrics.zeros(),
+    )
 
 
 def _mix(x: jnp.ndarray) -> jnp.ndarray:

@@ -1,13 +1,18 @@
 from ue22cs343bb1_openmp_assignment_tpu.parallel.mesh import (
-    make_mesh, make_multihost_mesh, state_shardings, shard_state)
+    flatten_mesh, make_mesh, make_multihost_mesh, state_shardings,
+    shard_state)
 from ue22cs343bb1_openmp_assignment_tpu.parallel.shardmap_comm import (
     candidate_prio, make_router, pack_fields)
 from ue22cs343bb1_openmp_assignment_tpu.parallel.sharded_step import (
     make_sharded_cycle, make_sharded_round,
-    make_sharded_round_runner, make_sharded_runner)
+    make_sharded_round_runner, make_sharded_runner,
+    make_transport_cycle, make_transport_runner)
+from ue22cs343bb1_openmp_assignment_tpu.parallel import rdma_comm
 
-__all__ = ["make_mesh", "make_multihost_mesh",
+__all__ = ["flatten_mesh", "make_mesh", "make_multihost_mesh",
            "state_shardings", "shard_state",
            "make_sharded_cycle", "make_sharded_round",
            "make_sharded_round_runner", "make_sharded_runner",
-           "make_router", "candidate_prio", "pack_fields"]
+           "make_transport_cycle", "make_transport_runner",
+           "make_router", "candidate_prio", "pack_fields",
+           "rdma_comm"]

@@ -60,6 +60,23 @@ def make_multihost_mesh(num_hosts: Optional[int] = None,
     return Mesh(grid, (DCN_AXIS, AXIS))
 
 
+def flatten_mesh(mesh: Mesh) -> Mesh:
+    """The 1-D ('nodes',) transport view of any mesh.
+
+    The explicit transports (shardmap_comm / rdma_comm) address peers
+    by a single logical axis: interpret-mode remote DMA only discharges
+    scalar device ids over ONE named axis, and the lane math assumes a
+    flat shard index. A (hosts, nodes) grid flattens row-major, which
+    is placement-identical to the 2-D ``state_shardings`` layout —
+    ``P((DCN_AXIS, AXIS))`` on axis 0 assigns contiguous node runs to
+    devices in exactly row-major grid order — so entering a flat-mesh
+    shard_map from 2-D-sharded operands moves no data.
+    """
+    if mesh.axis_names == (AXIS,):
+        return mesh
+    return Mesh(mesh.devices.reshape(-1), (AXIS,))
+
+
 def state_shardings(cfg, mesh: Mesh, state):
     """NamedShardings for a machine-state pytree (SimState or SyncState):
     shard axis 0 when it is the node axis — or node-major like the

@@ -1,0 +1,332 @@
+"""Pallas remote-DMA mailbox transport: ring exchange + home-shard enqueue.
+
+The all_to_all router (parallel/shardmap_comm.py) materializes FOUR
+separate [D, cap(, Fw)] exchange tensors per step — a valid plane
+widened to int32, receiver ids, priorities and the payload planes —
+and hands them to `jax.lax.all_to_all`. This module is the same lane
+contract delivered the way the TPU's interconnect actually wants it
+(SNIPPETS.md [2], the `pltpu.make_async_remote_copy` neighbor-exchange
+kernel): each shard packs its outbox lanes into ONE [D, cap, 2 + Fw]
+int32 tensor (column 0 carries the receiver id with -1 as the
+invalid-row sentinel, so the valid plane rides for free; column 1 the
+arbitration priority; the rest the payload planes) and a Pallas ring
+kernel pushes lane (d + s) % D to neighbor (d + s) % D at step s with
+send/recv DMA semaphores — D - 1 permutation steps, no full-exchange
+tensor, and strictly fewer bytes on the wire per row
+(:func:`wire_bytes`: 2 + Fw words vs the router's 3 + Fw).
+
+Directory-by-home sharding invariant: `cycle`'s phase-1/2 writes are
+all own-row (a node updates only its own cache/memory/directory rows,
+and home(addr) ownership of directory rows follows the node axis), so
+sharding the node axis over the mesh ALREADY places every directory
+lookup shard-local — the only traffic that must cross shards is
+phase-3 mailbox delivery. :func:`make_routed_deliver` therefore swaps
+in for `ops.mailbox.deliver` alone (the ``deliver_fn`` hook in
+ops.step.cycle): bucket locally (shared `bucket_lanes` math), exchange
+lanes (RDMA ring or the all_to_all fallback), then run the *exact*
+shard-local image of deliver's sort/rank/capacity/position enqueue.
+Per-receiver order is preserved bit for bit because every receiver is
+wholly owned by one shard and `prio` is a global total order.
+
+Gating mirrors ops/pallas_round.py: :func:`supported` is a pure config
+predicate, :func:`native` says whether the attached backend compiles
+the kernel natively (real TPU) — everywhere else the kernel runs under
+the Pallas interpreter, which is the CPU-CI correctness contract
+(tests/test_shardmap_comm.py pins bit-parity vs the all_to_all
+router). Interpret-mode discharge constrains the kernel shape: scalar
+logical device ids, ONE named mesh axis (2-D meshes enter through
+`mesh.flatten_mesh`, placement-identical row-major), and a fully
+symmetric schedule — every device sends full lanes at every step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+try:                                    # jax >= 0.4.35 exports it at top level
+    from jax import shard_map
+except ImportError:                     # 0.4.x fallback (e.g. 0.4.37)
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.ops.mailbox import (
+    candidate_prio, segment_ranks)
+from ue22cs343bb1_openmp_assignment_tpu.parallel.mesh import (
+    AXIS, flatten_mesh)
+from ue22cs343bb1_openmp_assignment_tpu.parallel.shardmap_comm import (
+    RoutedMsgs, bucket_lanes, pack_fields)
+
+
+def supported(cfg: SystemConfig) -> bool:
+    """Pure predicate: can the routed transports deliver this config?
+
+    Fault injection (cfg.drop_prob > 0) draws ONE global bernoulli
+    vector in arbitration order from state.fault_key; a per-shard
+    deliver cannot reproduce that draw order, so routed delivery
+    requires the drop knob off. Everything else routes.
+    """
+    return cfg.drop_prob == 0.0
+
+
+def native() -> bool:
+    """True when the attached backend compiles the ring kernel natively
+    (real TPU). Everywhere else callers run interpret mode — the
+    correctness contract on CPU CI — or fall back to all_to_all."""
+    return jax.default_backend() == "tpu"
+
+
+def wire_bytes(cfg: SystemConfig, n_shards: int,
+               lane_cap: int | None = None,
+               transport: str = "rdma") -> int:
+    """Interconnect bytes per lane exchange — pure shape arithmetic.
+
+    Both transports move D * (D - 1) non-self lanes of `cap` rows. An
+    all_to_all row is 3 + Fw int32 words (valid plane widened to i32,
+    recv, prio, Fw payload words, each its own exchange tensor); an
+    RDMA row is 2 + Fw (validity rides in the receiver column's -1
+    sentinel). The perf-report transport row and the check.sh gate are
+    this function — same basis as pallas_round.io_contract_bytes.
+    """
+    if cfg.num_nodes % n_shards:
+        raise ValueError(
+            f"{cfg.num_nodes} nodes do not shard over {n_shards} devices")
+    L = cfg.num_nodes // n_shards
+    cap = lane_cap if lane_cap is not None else L * cfg.out_slots
+    Fw = 6 + cfg.msg_bitvec_words
+    words = {"all_to_all": 3 + Fw, "rdma": 2 + Fw}[transport]
+    return n_shards * (n_shards - 1) * cap * words * 4
+
+
+def _ring_exchange(D: int, cap: int, width: int, interpret: bool):
+    """Build the [D, cap, width] i32 lane exchange as one pallas_call.
+
+    Step s pushes outbox lane (my_id + s) % D to device (my_id + s) % D,
+    landing in the receiver's inbox at lane my_id — a permutation per
+    step, so interpret-mode discharge matches exactly one sender per
+    receiver, and after D - 1 steps plus the local self-copy the inbox
+    lane layout (lane j = from shard j) is identical to all_to_all's.
+    """
+
+    def kernel(ob_ref, ib_ref, send_sem, recv_sem, local_sem):
+        my_id = lax.axis_index(AXIS)
+        # self lane never touches the wire: local async copy
+        self_copy = pltpu.make_async_copy(
+            ob_ref.at[my_id], ib_ref.at[my_id], local_sem)
+        self_copy.start()
+        self_copy.wait()
+        for s in range(1, D):
+            dst = lax.rem(my_id + s, D)
+            # sender indexes BOTH refs: src lane dst (rows bound for
+            # shard dst), dst lane my_id (receiver's from-me slot)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=ob_ref.at[dst], dst_ref=ib_ref.at[my_id],
+                send_sem=send_sem, recv_sem=recv_sem,
+                device_id=dst,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            rdma.start()
+            # full barrier per step: the recv wait also orders the
+            # reused semaphores for the next step's permutation
+            rdma.wait()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA] * 3,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((D, cap, width), jnp.int32),
+        grid_spec=grid_spec, interpret=interpret)
+
+
+def _pack_lanes(ob_valid, ob_recv, ob_prio, ob_fields):
+    """[D, cap, 2 + Fw] wire image: recv (-1 = invalid), prio, payload."""
+    return jnp.concatenate(
+        [jnp.where(ob_valid, ob_recv, -1)[..., None],
+         ob_prio[..., None], ob_fields], axis=-1)
+
+
+def _unpack_lanes(ib):
+    """Invert :func:`_pack_lanes` to the router's inbox quadruple.
+
+    Invalid rows decode to (False, 0, 0, 0…) — bit-identical to the
+    all_to_all router's zero-initialized lanes."""
+    recv = ib[..., 0]
+    valid = recv >= 0
+    return (valid, jnp.where(valid, recv, 0), ib[..., 1], ib[..., 2:])
+
+
+def _transport_geometry(cfg: SystemConfig, mesh: Mesh,
+                        lane_cap: int | None):
+    mesh = flatten_mesh(mesh)
+    D = mesh.shape[AXIS]
+    N, S = cfg.num_nodes, cfg.out_slots
+    if N % D:
+        raise ValueError(f"{N} nodes do not shard over {D} devices")
+    L = N // D
+    cap = lane_cap if lane_cap is not None else L * S
+    Fw = 6 + cfg.msg_bitvec_words
+    return mesh, D, N, S, L, cap, Fw
+
+
+def make_rdma_router(cfg: SystemConfig, mesh: Mesh,
+                     lane_cap: int | None = None,
+                     interpret: bool | None = None):
+    """Build `route(cand_type, recv, prio, fields) -> RoutedMsgs`.
+
+    Drop-in for shardmap_comm.make_router with the all_to_all replaced
+    by the RDMA ring — same node-sharded inputs, same sharded lane
+    pool, bit-identical output (the parity contract). Accepts 1-D or
+    2-D meshes (flattened row-major for the single transport axis).
+    `interpret=None` auto-selects: native compile on real TPU only.
+    """
+    mesh, D, N, S, L, cap, Fw = _transport_geometry(cfg, mesh, lane_cap)
+    if interpret is None:
+        interpret = not native()
+    exchange = _ring_exchange(D, cap, 2 + Fw, interpret)
+
+    def local_route(ctype, recv, prio, fields):
+        ob_valid, ob_recv, ob_prio, ob_fields, truncated = bucket_lanes(
+            ctype, recv, prio, fields, N=N, D=D, L=L, cap=cap, Fw=Fw)
+        ib = exchange(_pack_lanes(ob_valid, ob_recv, ob_prio, ob_fields))
+        ib_valid, ib_recv, ib_prio, ib_fields = _unpack_lanes(ib)
+        return (ib_valid.reshape(D * cap), ib_recv.reshape(D * cap),
+                ib_prio.reshape(D * cap),
+                ib_fields.reshape(D * cap, Fw),
+                lax.psum(truncated, AXIS)[None])
+
+    @jax.jit
+    def route(ctype, recv, prio, fields) -> RoutedMsgs:
+        out = shard_map(
+            local_route, mesh=mesh,
+            in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+            out_specs=(P(AXIS),) * 5, check_rep=False)(
+                ctype, recv, prio, fields)
+        return RoutedMsgs(out[0], out[1], out[2], out[3], out[4][0])
+
+    return route
+
+
+def _local_enqueue(N: int, L: int, S: int, Q: int,
+                   ib_valid, ib_recv, ib_prio, ib_fields,
+                   mb_pack, new_head, new_count):
+    """The shard-local image of ops.mailbox.deliver's enqueue.
+
+    Inputs are this shard's inbox pool ([D * cap] rows whose receivers
+    are all locally owned) and its slices of the ring state. Same
+    sort key shape, segment ranking, capacity test and position math
+    as deliver — receiver ids are just rebased to local rows, and prio
+    is globally unique, so each receiver's ring writes come out in the
+    identical order and positions as the unsharded global sort.
+    """
+    my_id = lax.axis_index(AXIS)
+    F = ib_valid.shape[0]
+    lr = jnp.where(ib_valid, ib_recv - my_id * L, L)    # local receiver row
+    # group by (local receiver, prio) — fused key when it fits int32,
+    # else deliver's two-stable-sort lexicographic fallback (the 2^20-
+    # node rungs overflow the fused key exactly like deliver's guard)
+    prio_span = N * S                                   # prio < N * S
+    if (L + 1) * prio_span + prio_span < 2**31:
+        key = jnp.where(ib_valid, lr * prio_span + ib_prio,
+                        jnp.iinfo(jnp.int32).max)
+        order = jnp.argsort(key)
+    else:
+        order1 = jnp.argsort(
+            jnp.where(ib_valid, ib_prio, jnp.iinfo(jnp.int32).max),
+            stable=True)
+        key2 = jnp.where(ib_valid[order1], lr[order1],
+                         jnp.iinfo(jnp.int32).max)
+        order = order1[jnp.argsort(key2, stable=True)]
+    r_s = lr[order]
+    v_s = ib_valid[order]
+    rank, _ = segment_ranks(r_s, v_s)
+    safe_r = jnp.where(v_s, r_s, 0)
+    free = (Q - new_count)[safe_r]
+    accept = v_s & (rank < free)
+    dropped = jnp.sum(v_s & ~accept).astype(jnp.int32)
+    pos = (new_head[safe_r] + new_count[safe_r] + rank) % Q
+    tgt_r = jnp.where(accept, r_s, L)       # OOB row -> dropped by scatter
+    tgt_p = jnp.where(accept, pos, 0)
+    pack = ib_fields[order].T               # [6 + Wm, F]
+    return (mb_pack.at[:, tgt_r, tgt_p].set(pack, mode="drop"),
+            new_count.at[tgt_r].add(accept.astype(jnp.int32), mode="drop"),
+            dropped)
+
+
+def make_routed_deliver(cfg: SystemConfig, mesh: Mesh,
+                        lane_cap: int | None = None,
+                        interpret: bool | None = None,
+                        transport: str | None = None):
+    """Build a ``deliver_fn`` for ops.step.cycle: routed phase-3 delivery.
+
+    One shard_map per cycle: shared lane bucketing, the selected lane
+    exchange (``cfg.transport`` — 'rdma' ring kernel or the explicit
+    'all_to_all' router collective), then the shard-local deliver
+    image. Same return contract as mailbox.deliver (updates dict,
+    dropped, injected); requires :func:`supported` (drop_prob == 0, so
+    injected is always 0 and fault_key passes through untouched).
+    Default lane_cap (L * S) is lossless by construction — every
+    shard's whole outbox fits its lanes — so routed dropped counts are
+    pure ring-capacity drops, identical to the global path's.
+    """
+    if not supported(cfg):
+        raise ValueError(
+            "routed delivery requires cfg.drop_prob == 0 (the global "
+            "fault-injection draw order cannot be reproduced per shard)")
+    mesh, D, N, S, L, cap, Fw = _transport_geometry(cfg, mesh, lane_cap)
+    transport = transport if transport is not None else cfg.transport
+    Q = cfg.queue_capacity
+    if interpret is None:
+        interpret = not native()
+    exchange = (_ring_exchange(D, cap, 2 + Fw, interpret)
+                if transport == "rdma" else None)
+
+    def local_deliver(mb_pack, ctype, recv, prio, fields,
+                      new_head, new_count):
+        ob_valid, ob_recv, ob_prio, ob_fields, truncated = bucket_lanes(
+            ctype, recv, prio, fields, N=N, D=D, L=L, cap=cap, Fw=Fw)
+        if transport == "rdma":
+            ib = exchange(
+                _pack_lanes(ob_valid, ob_recv, ob_prio, ob_fields))
+            ib_valid, ib_recv, ib_prio, ib_fields = _unpack_lanes(ib)
+        else:
+            ib_valid, ib_recv, ib_prio, ib_fields = [
+                lax.all_to_all(x, AXIS, split_axis=0, concat_axis=0,
+                               tiled=True)
+                for x in (ob_valid.astype(jnp.int32), ob_recv, ob_prio,
+                          ob_fields)]
+            ib_valid = ib_valid.astype(bool)
+        F = D * cap
+        mb_pack, mb_count, enq_dropped = _local_enqueue(
+            N, L, S, Q, ib_valid.reshape(F), ib_recv.reshape(F),
+            ib_prio.reshape(F), ib_fields.reshape(F, Fw),
+            mb_pack, new_head, new_count)
+        # lane-cap truncation is zero at the lossless default; with an
+        # explicit tighter cap it is still a drop, so count it
+        dropped = lax.psum(enq_dropped + truncated, AXIS)
+        return mb_pack, mb_count, dropped[None]
+
+    routed = shard_map(
+        local_deliver, mesh=mesh,
+        in_specs=(P(None, AXIS, None), P(AXIS), P(AXIS), P(AXIS),
+                  P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=(P(None, AXIS, None), P(AXIS), P(AXIS)),
+        check_rep=False)
+
+    def deliver_fn(cfg_, state, cand, arb_rank, new_head, new_count):
+        prio = candidate_prio(cfg_, arb_rank)
+        fields = pack_fields(cand)                       # [N, S, 6 + Wm]
+        mb_pack, mb_count, dropped = routed(
+            state.mb_pack, cand.type, cand.recv, prio, fields,
+            new_head, new_count)
+        updates = dict(mb_pack=mb_pack, mb_head=new_head,
+                       mb_count=mb_count, fault_key=state.fault_key)
+        return updates, dropped[0], jnp.zeros((), jnp.int32)
+
+    return deliver_fn

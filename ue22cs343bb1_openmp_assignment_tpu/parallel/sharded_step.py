@@ -80,6 +80,62 @@ def make_sharded_ledger_runner(cfg: SystemConfig, mesh, example_state,
     return run
 
 
+def make_transport_cycle(cfg: SystemConfig, mesh, example_state,
+                         transport: str | None = None,
+                         interpret: bool | None = None):
+    """jit one cycle with phase-3 delivery routed by the explicit
+    transport (cfg.transport: 'all_to_all' lane collective or the
+    'rdma' Pallas ring, parallel/rdma_comm) instead of leaving the
+    delivery scatter to GSPMD. Falls back to the implicit path when
+    the config can't route (rdma_comm.supported) or the mesh is a
+    single device (no cross-shard traffic to route)."""
+    from ue22cs343bb1_openmp_assignment_tpu.parallel import rdma_comm
+    from ue22cs343bb1_openmp_assignment_tpu.parallel.mesh import (
+        flatten_mesh)
+    sh = state_shardings(cfg, mesh, example_state)
+    flat = flatten_mesh(mesh)
+    if flat.devices.size == 1 or not rdma_comm.supported(cfg):
+        deliver_fn = None
+    else:
+        deliver_fn = rdma_comm.make_routed_deliver(
+            cfg, flat, interpret=interpret, transport=transport)
+    return jax.jit(lambda s: cycle(cfg, s, deliver_fn=deliver_fn),
+                   in_shardings=(sh,), out_shardings=sh)
+
+
+def make_transport_runner(cfg: SystemConfig, mesh, example_state,
+                          num_cycles: int,
+                          transport: str | None = None,
+                          interpret: bool | None = None):
+    """jit a `num_cycles`-cycle scan with routed phase-3 delivery —
+    the explicit-transport twin of make_sharded_runner (same read-only
+    hoist, one dispatch for the whole run)."""
+    from ue22cs343bb1_openmp_assignment_tpu.ops.step import _ro_outside
+    from ue22cs343bb1_openmp_assignment_tpu.parallel import rdma_comm
+    from ue22cs343bb1_openmp_assignment_tpu.parallel.mesh import (
+        flatten_mesh)
+    sh = state_shardings(cfg, mesh, example_state)
+    flat = flatten_mesh(mesh)
+    if flat.devices.size == 1 or not rdma_comm.supported(cfg):
+        deliver_fn = None
+    else:
+        deliver_fn = rdma_comm.make_routed_deliver(
+            cfg, flat, interpret=interpret, transport=transport)
+
+    @functools.partial(jax.jit, in_shardings=(sh,), out_shardings=sh)
+    def run(state):
+        carry0, ro, blanks = _ro_outside(state)
+
+        def body(s, _):
+            out = cycle(cfg, s.replace(**ro), deliver_fn=deliver_fn)
+            return out.replace(**blanks), None
+
+        final, _ = jax.lax.scan(body, carry0, None, length=num_cycles)
+        return final.replace(**ro)
+
+    return run
+
+
 def make_sharded_round(cfg: SystemConfig, mesh, example_state):
     """jit one transactional-engine round (ops.sync_engine) with
     node-axis shardings: caches/traces partition by node, the flat

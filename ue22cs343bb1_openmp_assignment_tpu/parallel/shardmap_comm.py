@@ -56,6 +56,49 @@ def pack_fields(cand: Candidates) -> jnp.ndarray:
     return jnp.moveaxis(pack_candidates(cand), 0, -1)
 
 
+def bucket_lanes(ctype, recv, prio, fields, *, N, D, L, cap, Fw):
+    """Shard-local bucketing shared by both transports.
+
+    Flattens this shard's [L, S] candidate planes, sorts by the fused
+    (dest shard, prio) key, ranks within each destination bucket
+    (segment_ranks, shared with deliver) and places the fitting rows
+    into [D, cap] outbox lanes — lane d holds the rows bound for shard
+    d in priority order. Returns
+    ``(ob_valid [D,cap] bool, ob_recv, ob_prio, ob_fields [D,cap,Fw],
+    truncated [] i32)``. Identical math for the all_to_all router and
+    the RDMA ring (parallel/rdma_comm.py); only the exchange differs.
+    """
+    F = ctype.size
+    ctype, recv, prio = (ctype.reshape(F), recv.reshape(F),
+                         prio.reshape(F))
+    fields = fields.reshape(F, Fw)
+    valid = (ctype != int(Msg.NONE)) & (recv >= 0) & (recv < N)
+    dest = jnp.where(valid, recv // L, D)          # dest shard (D = none)
+    # order by (dest, prio): a fused total key — D * (N * S) ranges
+    # within int32 at simulator scales (prio < N * S)
+    key = jnp.where(valid, dest * (N * (F // L)) + prio,
+                    jnp.iinfo(jnp.int32).max)
+    order = jnp.argsort(key)
+    d_s = dest[order]
+    v_s = valid[order]
+    # rank within each destination bucket (shared with deliver)
+    rank, _ = segment_ranks(d_s, v_s)
+    fit = v_s & (rank < cap)
+    truncated = jnp.sum(v_s & ~fit).astype(jnp.int32)
+    # outbox lanes: [D, cap] rows per destination shard
+    tgt_d = jnp.where(fit, d_s, D)
+    tgt_r = jnp.where(fit, rank, 0)
+    ob_valid = jnp.zeros((D, cap), bool).at[tgt_d, tgt_r].set(
+        fit, mode="drop")
+    ob_recv = jnp.zeros((D, cap), jnp.int32).at[tgt_d, tgt_r].set(
+        recv[order], mode="drop")
+    ob_prio = jnp.zeros((D, cap), jnp.int32).at[tgt_d, tgt_r].set(
+        prio[order], mode="drop")
+    ob_fields = jnp.zeros((D, cap, Fw), jnp.int32).at[
+        tgt_d, tgt_r].set(fields[order], mode="drop")
+    return ob_valid, ob_recv, ob_prio, ob_fields, truncated
+
+
 class RoutedMsgs(NamedTuple):
     """Per-shard inbound candidates after the all-to-all exchange.
 
@@ -95,34 +138,8 @@ def make_router(cfg: SystemConfig, mesh: Mesh, lane_cap: int | None = None):
 
     def local_route(ctype, recv, prio, fields):
         # shapes: [L, S], [L, S], [L, S], [L, S, Fw]
-        F = L * S
-        ctype, recv, prio = (ctype.reshape(F), recv.reshape(F),
-                             prio.reshape(F))
-        fields = fields.reshape(F, Fw)
-        valid = (ctype != int(Msg.NONE)) & (recv >= 0) & (recv < N)
-        dest = jnp.where(valid, recv // L, D)      # dest shard (D = none)
-        # order by (dest, prio): a fused total key — F * prio ranges
-        # within int32 at simulator scales (F = L * S, prio < N * S)
-        key = jnp.where(valid, dest * (N * S) + prio,
-                        jnp.iinfo(jnp.int32).max)
-        order = jnp.argsort(key)
-        d_s = dest[order]
-        v_s = valid[order]
-        # rank within each destination bucket (shared with deliver)
-        rank, _ = segment_ranks(d_s, v_s)
-        fit = v_s & (rank < cap)
-        truncated = jnp.sum(v_s & ~fit).astype(jnp.int32)
-        # outbox lanes: [D, cap] rows per destination shard
-        tgt_d = jnp.where(fit, d_s, D)
-        tgt_r = jnp.where(fit, rank, 0)
-        ob_valid = jnp.zeros((D, cap), bool).at[tgt_d, tgt_r].set(
-            fit, mode="drop")
-        ob_recv = jnp.zeros((D, cap), jnp.int32).at[tgt_d, tgt_r].set(
-            recv[order], mode="drop")
-        ob_prio = jnp.zeros((D, cap), jnp.int32).at[tgt_d, tgt_r].set(
-            prio[order], mode="drop")
-        ob_fields = jnp.zeros((D, cap, Fw), jnp.int32).at[
-            tgt_d, tgt_r].set(fields[order], mode="drop")
+        ob_valid, ob_recv, ob_prio, ob_fields, truncated = bucket_lanes(
+            ctype, recv, prio, fields, N=N, D=D, L=L, cap=cap, Fw=Fw)
         # THE collective: lane d of this shard's outbox becomes lane
         # <this shard> of shard d's inbox — ICI traffic, one exchange
         ib_valid, ib_recv, ib_prio, ib_fields = [

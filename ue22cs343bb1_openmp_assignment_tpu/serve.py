@@ -280,10 +280,23 @@ def _host_quiescent(host) -> np.ndarray:
 
 
 # lint: host
+def batch_shardings(mesh, bstate):
+    """NamedShardings partitioning every batched leaf's leading slot
+    axis over the 1-D ('batch',) mesh. ``state.stack_states`` stacks
+    EVERY leaf (scalars included), so each one has the [B] axis and the
+    whole wave partitions with zero replicated per-slot state."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return jax.tree.map(
+        lambda x: NamedSharding(
+            mesh, P("batch", *([None] * (x.ndim - 1)))), bstate)
+
+
+# lint: host
 def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
           slot_trace_len: Optional[int] = None, chunk: int = 32,
           max_cycles: int = 100_000, queue_capacity: int = 64,
-          out_dir=None, quiet: bool = True) -> dict:
+          out_dir=None, quiet: bool = True, devices: int = 1) -> dict:
     """Run a stream of jobs through fixed-shape batch waves.
 
     Jobs are grouped by protocol (each protocol is its own wave
@@ -294,15 +307,42 @@ def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
     ``state.set_state`` — admission never restacks, so the jit cache
     stays warm.
 
+    ``devices > 1`` shards the batch (slot) axis over that many local
+    devices (the ROADMAP item-2 remainder): every stacked leaf
+    partitions its leading axis over a 1-D ('batch',) mesh, so each
+    device runs slots/devices independent sims and XLA inserts no
+    cross-device collectives inside the wave (slots are independent by
+    construction). Admission (``set_state``) and extraction are
+    unchanged — jit keeps the sharding layout across waves. Requires
+    ``slots % devices == 0``.
+
     Returns the ``cache-sim/serve/v1`` summary doc; per-job results
     (dumps + metrics docs) are in ``doc["jobs"]`` and, when ``out_dir``
-    is given, streamed to ``<out_dir>/<job>/`` as they finish.
+    is given, streamed to ``<out_dir>/<job>/`` as they finish. Any
+    wave that reports mailbox-overflow drops (``mb_dropped`` — quirk
+    6's silent drop, surfaced) warns LOUDLY on stderr even under
+    ``quiet``: a dropped reply can leave its requester blocked forever,
+    so drops usually explain a non-quiescing job.
     """
     import jax
 
     from ue22cs343bb1_openmp_assignment_tpu import state as st
     from ue22cs343bb1_openmp_assignment_tpu.ops import step
     from ue22cs343bb1_openmp_assignment_tpu.utils import golden
+
+    if devices < 1:
+        raise ValueError("devices must be >= 1")
+    mesh = None
+    if devices > 1:
+        from jax.sharding import Mesh
+        avail = jax.devices()
+        if devices > len(avail):
+            raise ValueError(
+                f"devices={devices} but only {len(avail)} available")
+        if slots % devices:
+            raise ValueError(
+                f"slots={slots} does not shard over devices={devices}")
+        mesh = Mesh(avail[:devices], ("batch",))
 
     t_start = time.perf_counter()
     by_proto: Dict[str, List[JobSpec]] = {}
@@ -314,6 +354,7 @@ def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
     waves: List[dict] = []
     slot_budget_total = 0
     real_total = 0
+    mb_dropped_total = 0
 
     for protocol, queue in by_proto.items():
         scfg = slot_config(queue, slot_nodes, slot_trace_len,
@@ -341,6 +382,8 @@ def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
             else:
                 states.append(empty)
         bstate = st.stack_states(states)
+        if mesh is not None:
+            bstate = jax.device_put(bstate, batch_shardings(mesh, bstate))
 
         while any(o is not None for o in occupant):
             real = sum(real_by_slot)
@@ -354,6 +397,12 @@ def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
             wave_s = time.perf_counter() - t0
             budget = slots * N * T
             finished = [o.name for o in occupant if o is not None]
+            # quirk 6 surfaced: per-slot mailbox-overflow drop counts
+            # (cumulative per job — each occupied slot resolves this
+            # wave, so this is the finishing jobs' total)
+            occ = np.array([o is not None for o in occupant])
+            wave_dropped = int(np.sum(
+                np.asarray(host.metrics.msgs_dropped)[occ]))
             waves.append({
                 "protocol": protocol,
                 "jobs": finished,
@@ -361,9 +410,22 @@ def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
                 "slot_instr_budget": budget,
                 "real_instrs": real,
                 "padding_waste": 1.0 - real / budget,
+                "mb_dropped": wave_dropped,
             })
             slot_budget_total += budget
             real_total += real
+            mb_dropped_total += wave_dropped
+            if wave_dropped:
+                # loud on purpose, quiet or not: a silently dropped
+                # reply leaves its requester blocked forever (the
+                # reference's unreachable overflow, quirk 6) — this is
+                # almost always why a job fails to quiesce
+                import sys
+                print(f"serve: WARNING wave {len(waves)} [{protocol}] "
+                      f"dropped {wave_dropped} mailbox message(s) on "
+                      f"overflow (quirk 6) — raise --queue-capacity; "
+                      f"affected jobs: {', '.join(finished)}",
+                      file=sys.stderr)
             if not quiet:
                 print(f"serve: wave {len(waves)} [{protocol}] "
                       f"jobs={len(finished)} wall={wave_s:.3f}s "
@@ -413,6 +475,8 @@ def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
     doc = {
         "schema": SCHEMA_ID,
         "slots": slots,
+        "devices": devices,
+        "mb_dropped": mb_dropped_total,
         "jobs_total": n_jobs,
         "jobs_quiesced": sum(1 for d in job_docs.values() if d["quiesced"]),
         "waves": waves,
@@ -459,6 +523,9 @@ def main(argv=None) -> int:
     ap.add_argument("--slot-trace-len", type=int, default=None,
                     help="slot trace length (default: max over jobs)")
     ap.add_argument("--queue-capacity", type=int, default=64)
+    ap.add_argument("--devices", type=int, default=1,
+                    help="shard the batch axis over N local devices "
+                         "(slots must divide evenly; default 1)")
     ap.add_argument("--chunk", type=int, default=32)
     ap.add_argument("--max-cycles", type=int, default=100_000)
     ap.add_argument("--out-dir", default=None,
@@ -477,7 +544,8 @@ def main(argv=None) -> int:
                 slot_trace_len=args.slot_trace_len, chunk=args.chunk,
                 max_cycles=args.max_cycles,
                 queue_capacity=args.queue_capacity,
-                out_dir=args.out_dir, quiet=False)
+                out_dir=args.out_dir, quiet=False,
+                devices=args.devices)
     if args.json:
         print(json.dumps(doc, indent=2))
     else:
