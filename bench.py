@@ -139,6 +139,133 @@ def _serve_bench(args, jax):
     return 0
 
 
+def _soak_bench(args, jax):
+    """--soak: open-loop p95 job latency through the soak harness.
+
+    Unlike --serve (closed loop: the whole stream is present at entry,
+    the unit is jobs/sec), the soak RELEASES the mixed stream at
+    --arrival-rate jobs/sec regardless of completions and measures
+    per-job latency from the scheduled arrival — free of coordinated
+    omission (PERF.md). The headline is the p95 end-to-end job latency
+    in ms; the full sample vector rides the history entry's v1.4
+    latency block so `cache-sim bench-diff --latency` can adjudicate a
+    latency change with the Mann-Whitney machinery instead of two bare
+    percentiles.
+    """
+    from ue22cs343bb1_openmp_assignment_tpu import soak as soak_mod
+    from ue22cs343bb1_openmp_assignment_tpu.obs.clock import VirtualClock
+
+    max_cycles = args.max_cycles or 100_000
+    qcap = args.queue_capacity or max(64, 2 * args.nodes)
+    arrivals = soak_mod.soak_stream(
+        args.arrival_rate, args.soak_duration, nodes=args.nodes,
+        trace_len=args.trace_len, seed=0)
+
+    def run(clock=None):
+        return soak_mod.soak(arrivals, slots=args.serve_slots,
+                             chunk=args.chunk, max_cycles=max_cycles,
+                             queue_capacity=qcap,
+                             arrival_rate=args.arrival_rate,
+                             clock=clock)
+
+    from ue22cs343bb1_openmp_assignment_tpu.obs.phases import PhaseTimer
+    timer = PhaseTimer()
+    with timer.phase("warmup_compile"):
+        # same wave jit signature on a virtual clock: compiles the
+        # wave for this slot shape without wall-clock latency samples
+        run(VirtualClock())
+
+    t0 = time.perf_counter()
+    doc = run()                        # MonotonicClock: real latencies
+    timer.add("soak_pass", time.perf_counter() - t0)
+
+    lat = doc["latency"]
+    if lat is None:
+        print("error: the soak released no jobs (duration too short "
+              "for the arrival rate)", file=sys.stderr)
+        return 1
+    platform = jax.devices()[0].platform
+    result = {
+        "metric": f"soak p95 job latency @{args.nodes}x"
+                  f"{args.trace_len} (async engine, mixed traffic, "
+                  f"open loop, {platform})",
+        "value": round(lat["p95_ms"], 3),
+        "unit": "ms p95",
+        "vs_baseline": 0.0,
+    }
+    quiet = doc["jobs_quiesced"] == doc["jobs_total"]
+    extra = {
+        "engine": "async",
+        "steps": doc["wave_count"],
+        "retired": None,
+        "quiescent": quiet,
+        "elapsed_s": round(doc["wall_s"], 3),
+        "rep_times_s": [round(doc["wall_s"], 3)],
+        "phases": timer.report(),
+        "latency": {k: (round(v, 3) if isinstance(v, float) else v)
+                    for k, v in lat.items()},
+        "verdict": doc["verdict"],
+        "mb_dropped": doc["mb_dropped"],
+        "padding_waste": round(doc["padding_waste"], 4),
+    }
+    print(json.dumps(result))
+    print(json.dumps(extra), file=sys.stderr)
+
+    if args.record:
+        from ue22cs343bb1_openmp_assignment_tpu.obs import (
+            history, roofline)
+        fingerprint = {
+            "engine": "async", "mode": "soak", "workload": "mixed",
+            "nodes": args.nodes, "trace_len": args.trace_len,
+            "chunk": args.chunk, "max_cycles": max_cycles,
+            "slots": args.serve_slots,
+            "arrival_rate": args.arrival_rate,
+            "duration_s": args.soak_duration,
+            "platform": platform, "smoke": bool(args.smoke),
+        }
+        latency_block = {
+            "p50_ms": lat["p50_ms"], "p95_ms": lat["p95_ms"],
+            "p99_ms": lat["p99_ms"], "max_ms": lat["max_ms"],
+            "jobs": lat["jobs"],
+            "arrival_rate": float(args.arrival_rate),
+            "queue_depth_peak": doc["series_summary"]["queue_depth_peak"],
+            "samples_ms": [round(s["e2e_s"] * 1e3, 6)
+                           for s in doc["trace"]["spans"]],
+            "duration_s": float(args.soak_duration),
+            "saturated": doc["verdict"]["saturated"],
+            "drain_rate_jobs_per_s": doc["drain_rate_jobs_per_s"],
+        }
+        serve_block = {
+            "slots": args.serve_slots, "jobs": doc["jobs_total"],
+            "waves": doc["wave_count"], "devices": 1,
+            "mb_dropped": doc["mb_dropped"],
+            "padding_waste": round(doc["padding_waste"], 4),
+        }
+        hist_doc = history.entry(
+            label=f"soak@{args.arrival_rate:g}/s",
+            source="bench.py",
+            result=result, extra={k: v for k, v in extra.items()
+                                  if k not in ("latency", "verdict",
+                                               "mb_dropped",
+                                               "padding_waste")},
+            config=fingerprint,
+            sha=history.git_sha(os.path.dirname(
+                os.path.abspath(__file__))),
+            captured_at=time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            device_kind=roofline.detect_device_kind(),
+            serve=serve_block, latency=latency_block)
+        history.append(args.record, hist_doc)
+        print(f"recorded to {args.record}", file=sys.stderr)
+
+    if not quiet:
+        print(f"error: {doc['jobs_total'] - doc['jobs_quiesced']} "
+              f"job(s) hit the {max_cycles}-cycle budget without "
+              "quiescing — the latency tail is not trustworthy",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--engine", choices=["sync", "async", "deep"],
@@ -266,6 +393,18 @@ def main():
     ap.add_argument("--serve-jobs", type=int, default=None,
                     help="jobs in the --serve traffic mix (default "
                          "2x slots so every slot turns over once)")
+    ap.add_argument("--soak", action="store_true",
+                    help="open-loop latency bench: release the mixed "
+                         "stream at --arrival-rate through the soak "
+                         "harness (soak.py) and report p95 job "
+                         "latency in ms; records a v1.4 latency "
+                         "block for `bench-diff --latency`")
+    ap.add_argument("--arrival-rate", type=float, default=20.0,
+                    help="--soak: jobs per second released "
+                         "(default 20)")
+    ap.add_argument("--soak-duration", type=float, default=2.0,
+                    help="--soak: arrival window in seconds "
+                         "(default 2); the run drains fully after")
     ap.add_argument("--devices", type=int, default=1,
                     help="--serve: shard the wave's batch axis over "
                          "this many local devices (serve.py batch "
@@ -323,12 +462,18 @@ def main():
 
     if args.smoke:
         args.nodes, args.trace_len, args.chunk = 64, 8, 8
-        if args.serve:
+        if args.serve or args.soak:
             # serving smoke: many small tenants, not one 64-node machine
             args.nodes = 8
 
+    if args.serve and args.soak:
+        print("error: --serve and --soak are exclusive (closed-loop "
+              "jobs/sec vs open-loop latency)", file=sys.stderr)
+        return 2
     if args.serve:
         return _serve_bench(args, jax)
+    if args.soak:
+        return _soak_bench(args, jax)
 
     sync_like = args.engine in ("sync", "deep")
     if args.txn_width is not None and not sync_like:

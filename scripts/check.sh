@@ -203,6 +203,43 @@ print(f"serve smoke: ok (8/8 jobs quiesced in {doc['wave_count']} "
       f"{spec.name} batched dump == solo)")
 PYEOF
 
+# Soak smoke (30s box): the open-loop latency harness on the
+# deterministic virtual clock. An easy p95 SLO must pass (exit 0); a
+# sub-wave p95 bound must breach (exit 4, the gate's own mutation
+# test) and dump a loadable incident dir. The emitted doc is checked
+# for the span decomposition invariant (queue_wait + run + extract
+# == e2e exactly) and full quiescence.
+SOAK_DIR="$(mktemp -d)"
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.cli soak \
+    --arrival-rate 50 --duration 0.3 --nodes 2 --trace-len 4 \
+    --slots 2 --virtual-clock --wave-s 0.01 --slo p95=100000 \
+    --out "$SOAK_DIR/soak.json"
+rc=0
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.cli soak \
+    --arrival-rate 50 --duration 0.3 --nodes 2 --trace-len 4 \
+    --slots 2 --virtual-clock --wave-s 0.01 --slo p95=0.001 \
+    --incident-dir "$SOAK_DIR/incident" || rc=$?
+if [[ "$rc" != 4 ]]; then
+    echo "soak SLO self-test FAILED: sub-wave p95 bound exited $rc," \
+         "want 4" >&2
+    exit 1
+fi
+python - "$SOAK_DIR" <<'PY'
+import json, pathlib, sys
+from ue22cs343bb1_openmp_assignment_tpu import soak
+d = pathlib.Path(sys.argv[1])
+doc = json.loads((d / "soak.json").read_text())
+assert doc["jobs_quiesced"] == doc["jobs_total"] > 0, doc
+for s in doc["trace"]["spans"]:
+    assert s["e2e_s"] == s["queue_wait_s"] + s["run_s"] + s["extract_s"]
+inc = soak.load_incident(d / "incident")
+assert inc["breaches"][0]["metric"] == "p95_ms"
+print(f"soak smoke: ok ({doc['jobs_total']} jobs quiesced, "
+      f"p95={doc['latency']['p95_ms']:.2f}ms virtual, "
+      f"SLO breach exit 4, incident loadable)")
+PY
+rm -rf "$SOAK_DIR"
+
 # RDMA-transport smoke (30s box): on 8 virtual CPU devices the Pallas
 # remote-DMA ring router (interpret mode — the CPU CI correctness
 # contract, parallel/rdma_comm) must bucket and exchange lanes

@@ -4,7 +4,7 @@ bench-history dashboard.
 Anchors pinned here: cost normalization across every shape XLA has
 shipped (dict / list / None / junk), the full bytes-gate rc matrix
 (pass 0 / synthetic +20% regression 4 / cross-device incomparable 2),
-bench-history schema v1.2 backward compatibility (v1 and v1.1 docs
+bench-history schema v1.4 backward compatibility (v1..v1.3 docs
 still validate, and may NOT smuggle newer keys), the multichip ingest
 (32/32/64/65536/65536/1048576 from the archived dryruns), and the
 dashboard golden render from exactly the eleven committed captures.
@@ -189,18 +189,30 @@ def test_bench_diff_bytes_cli_rc_matrix(tmp_path, capsys):
     assert rc == 2 and "different device" in out
 
 
-# -- schema v1.3 backcompat ------------------------------------------------
+# -- schema v1.4 backcompat ------------------------------------------------
 
 
 def test_schema_backcompat_matrix():
-    v13 = _entry("x")
-    assert v13["schema"] == "cache-sim/bench/v1.3"
+    v14 = _entry("x")
+    assert v14["schema"] == "cache-sim/bench/v1.4"
+    history.validate_entry(v14)
+    # a well-formed latency block rides v1.4 (the bench.py --soak row)
+    soaked = copy.deepcopy(v14)
+    soaked["latency"] = {"p50_ms": 1.0, "p95_ms": 2.0, "p99_ms": 3.0,
+                         "arrival_rate": 20.0, "queue_depth_peak": 4,
+                         "samples_ms": [0.5, 1.0, 2.0, 3.0]}
+    history.validate_entry(soaked)
+    # v1.3: serve allowed, latency NOT
+    v13 = copy.deepcopy(v14)
+    v13["schema"] = "cache-sim/bench/v1.3"
+    del v13["latency"]
+    v13["serve"] = {"slots": 8, "jobs": 16, "waves": 2,
+                    "padding_waste": 0.125}
     history.validate_entry(v13)
-    # a well-formed serve block rides v1.3
-    served = copy.deepcopy(v13)
-    served["serve"] = {"slots": 8, "jobs": 16, "waves": 2,
-                       "padding_waste": 0.125}
-    history.validate_entry(served)
+    v13_bad = copy.deepcopy(v13)
+    v13_bad["latency"] = soaked["latency"]
+    with pytest.raises(ValueError, match="unknown key: latency"):
+        history.validate_entry(v13_bad)
     # v1.2: cost allowed, serve NOT
     v12 = copy.deepcopy(v13)
     v12["schema"] = "cache-sim/bench/v1.2"
@@ -230,8 +242,8 @@ def test_schema_backcompat_matrix():
     v1_bad["device_kind"] = "cpu"
     with pytest.raises(ValueError, match="unknown key: device_kind"):
         history.validate_entry(v1_bad)
-    # malformed cost is rejected even on v1.3
-    bad = copy.deepcopy(v13)
+    # malformed cost is rejected even on v1.4
+    bad = copy.deepcopy(v14)
     bad["cost"] = {"bytes_per_instr": -1}
     with pytest.raises(ValueError):
         history.validate_entry(bad)
@@ -244,6 +256,20 @@ def test_schema_backcompat_matrix():
         bad = copy.deepcopy(v13)
         bad["serve"] = block
         with pytest.raises(ValueError, match="serve"):
+            history.validate_entry(bad)
+    # malformed latency blocks are rejected on v1.4
+    for block in ({"p50_ms": 3.0, "p95_ms": 2.0, "p99_ms": 4.0,
+                   "arrival_rate": 20.0, "queue_depth_peak": 0},
+                  {"p50_ms": 1.0, "p95_ms": 2.0, "p99_ms": 3.0,
+                   "arrival_rate": 20.0, "queue_depth_peak": 0,
+                   "bogus": 1},
+                  {"p50_ms": 1.0, "p95_ms": 2.0, "p99_ms": 3.0,
+                   "arrival_rate": 20.0, "queue_depth_peak": 0,
+                   "samples_ms": [1.0, -2.0]},
+                  ["not", "a", "dict"]):
+        bad = copy.deepcopy(v14)
+        bad["latency"] = block
+        with pytest.raises(ValueError, match="latency"):
             history.validate_entry(bad)
 
 

@@ -1,0 +1,260 @@
+"""Serving observability: the open-loop soak harness, job-lifecycle
+spans, and the latency SLO gate.
+
+Anchors pinned here: the deterministic seeded arrival schedule, the
+byte-identical virtual-clock soak doc (two runs, same bytes), the span
+decomposition invariant (queue_wait + run + extract == e2e EXACTLY,
+with submit stamped at the SCHEDULED arrival — the
+coordinated-omission guard), both backpressure regimes (under- and
+over-loaded), the SLO breach rc-4 path with its flight-recorder
+incident directory, and the bench-diff latency gate verdict matrix
+(regression / noise / incomparable) over v1.4 latency blocks.
+"""
+
+import json
+
+import pytest
+
+from ue22cs343bb1_openmp_assignment_tpu import soak
+from ue22cs343bb1_openmp_assignment_tpu.obs import (history, regress,
+                                                    timeseries)
+from ue22cs343bb1_openmp_assignment_tpu.obs.clock import VirtualClock
+from ue22cs343bb1_openmp_assignment_tpu.serve import JobSpec
+
+# small slot shape shared by every soak here: waves stay cheap and the
+# wave jit is warmed once for the whole module
+SOAK = dict(slots=2, arrival_rate=50.0)
+
+
+def _arrivals(rate=50.0, duration=0.3, seed=0):
+    return soak.soak_stream(rate, duration, nodes=2, trace_len=4,
+                            seed=seed)
+
+
+# -- arrival schedule ------------------------------------------------------
+
+
+def test_soak_stream_deterministic():
+    a = _arrivals()
+    b = _arrivals()
+    assert a == b                      # same seed, same bytes
+    assert a != _arrivals(seed=1)
+    assert all(t0 < t1 for (t0, _), (t1, _) in zip(a, a[1:]))
+    assert all(0.0 < t < 0.3 for t, _ in a)
+    # mixed traffic: the mix cycles through the serve workload set
+    assert len({s.workload for _, s in a}) > 1
+    with pytest.raises(ValueError, match="arrival_rate"):
+        soak.soak_stream(0.0, 1.0)
+    with pytest.raises(ValueError, match="duration_s"):
+        soak.soak_stream(1.0, 0.0)
+
+
+def test_soak_single_protocol_enforced():
+    arr = [(0.0, JobSpec(name="a", nodes=2, trace_len=4)),
+           (0.01, JobSpec(name="b", nodes=2, trace_len=4,
+                          protocol="msi"))]
+    with pytest.raises(ValueError, match="single-protocol"):
+        soak.soak(arr, **SOAK)
+
+
+# -- virtual-clock determinism and the span invariant ----------------------
+
+
+def _virtual_soak(wave_s=0.01):
+    return soak.soak(_arrivals(), clock=VirtualClock(wave_s=wave_s),
+                     **SOAK)
+
+
+def test_soak_virtual_clock_byte_identical():
+    a = _virtual_soak()
+    b = _virtual_soak()
+    assert json.dumps(a, sort_keys=True) == \
+        json.dumps(b, sort_keys=True)
+    assert a["schema"] == "cache-sim/soak/v1"
+    assert a["jobs_total"] == len(_arrivals())
+    assert a["jobs_quiesced"] == a["jobs_total"]
+    assert a["trace"]["schema"] == "cache-sim/serve-trace/v1"
+    assert a["trace"]["clock"] == "virtual"
+    assert a["latency"]["jobs"] == a["jobs_total"]
+    # the host series sampled every turn, summarized for the verdict
+    assert a["series"]["samples"] == len(a["series"]["series"]["t_s"])
+    assert a["series_summary"]["queue_depth_peak"] >= 0
+    assert 0.0 <= a["padding_waste"] <= 1.0
+
+
+def test_span_decomposition_invariant():
+    doc = _virtual_soak()
+    arrivals = dict((s.name, t) for t, s in _arrivals())
+    assert len(doc["trace"]["spans"]) == len(arrivals)
+    for s in doc["trace"]["spans"]:
+        # segments sum EXACTLY (floats included) — computed in one
+        # place from the lifecycle timestamps, never re-derived
+        assert s["e2e_s"] == \
+            s["queue_wait_s"] + s["run_s"] + s["extract_s"]
+        assert s["t_submit"] <= s["t_admitted"] <= s["t_quiescent"] \
+            <= s["t_extracted"]
+        # open loop: submit is the SCHEDULED arrival (the virtual
+        # clock starts at t0=0, so offsets compare directly) — a busy
+        # machine cannot slow the load generator down
+        assert s["t_submit"] == pytest.approx(arrivals[s["job"]])
+        assert s["quiesced"] is True
+
+
+def test_backpressure_regimes():
+    # fast waves: the machine drains faster than jobs arrive
+    under = _virtual_soak(wave_s=0.001)
+    assert not under["verdict"]["saturated"]
+    assert under["verdict"]["drain_rate_jobs_per_s"] > 50.0
+    # slow waves: arrivals outpace the drain and the queue backs up
+    over = _virtual_soak(wave_s=0.2)
+    assert over["verdict"]["saturated"]
+    assert over["verdict"]["queue_depth_peak"] > \
+        under["verdict"]["queue_depth_peak"]
+    # saturation never loses jobs: everything still quiesces
+    assert over["jobs_quiesced"] == over["jobs_total"]
+
+
+# -- SLO parsing and the gate ----------------------------------------------
+
+
+def test_parse_slo():
+    assert soak.parse_slo("p95=5,p99=20") == {"p95_ms": 5.0,
+                                              "p99_ms": 20.0}
+    assert soak.parse_slo(" p50 = 1.5 ") == {"p50_ms": 1.5}
+    with pytest.raises(ValueError, match="unknown SLO metric"):
+        soak.parse_slo("p42=1")
+    with pytest.raises(ValueError, match="bad SLO term"):
+        soak.parse_slo("p95")
+    with pytest.raises(ValueError, match="bad SLO bound"):
+        soak.parse_slo("p95=fast")
+    with pytest.raises(ValueError, match="must be > 0"):
+        soak.parse_slo("p95=0")
+    with pytest.raises(ValueError, match="empty SLO spec"):
+        soak.parse_slo(",")
+
+
+def test_check_slo():
+    lat = {"p50_ms": 1.0, "p95_ms": 5.0, "p99_ms": 9.0}
+    assert soak.check_slo(lat, {"p95_ms": 10.0}) == []
+    br = soak.check_slo(lat, {"p50_ms": 0.5, "p95_ms": 10.0})
+    assert br == [{"metric": "p50_ms", "limit_ms": 0.5,
+                   "observed_ms": 1.0}]
+    assert soak.check_slo(None, {"p95_ms": 0.001}) == []
+
+
+_CLI = ["--arrival-rate", "50", "--duration", "0.3", "--nodes", "2",
+        "--trace-len", "4", "--slots", "2", "--virtual-clock",
+        "--wave-s", "0.01"]
+
+
+def test_soak_cli_slo_pass(tmp_path, capsys):
+    out = tmp_path / "soak.json"
+    rc = soak.main(_CLI + ["--slo", "p95=100000", "--out", str(out)])
+    assert rc == 0
+    cap = capsys.readouterr()
+    assert "keeping up" in cap.out or "SATURATED" in cap.out
+    doc = json.loads(out.read_text())
+    assert doc["schema"] == "cache-sim/soak/v1"
+    assert doc["jobs_quiesced"] == doc["jobs_total"]
+
+
+def test_soak_cli_slo_breach_exit4_and_incident(tmp_path, capsys):
+    inc_dir = tmp_path / "incident"
+    # virtual run_s is wave_s = 10ms per wave, so a 0.001ms p95 bound
+    # must breach deterministically
+    rc = soak.main(_CLI + ["--slo", "p95=0.001",
+                           "--incident-dir", str(inc_dir)])
+    assert rc == soak.EXIT_SLO_BREACH == 4
+    cap = capsys.readouterr()
+    assert "SLO BREACH p95_ms" in cap.err
+    assert "incident dumped" in cap.err
+    inc = soak.load_incident(inc_dir)
+    assert inc["reason"] == "slo-breach"
+    assert inc["breaches"][0]["metric"] == "p95_ms"
+    assert inc["breaches"][0]["observed_ms"] > \
+        inc["breaches"][0]["limit_ms"]
+    # slowest-first, full spans, capped at INCIDENT_SLOWEST
+    slow = inc["slowest_jobs"]
+    assert 0 < len(slow) <= soak.INCIDENT_SLOWEST
+    assert all(x["e2e_s"] >= y["e2e_s"]
+               for x, y in zip(slow, slow[1:]))
+    assert {"job", "t_submit", "queue_wait_s", "run_s",
+            "extract_s"} <= set(slow[0])
+    # the Perfetto rendering rides along, listed in files
+    assert sorted(inc["files"]) == ["incident.json",
+                                    "trace.perfetto.json"]
+    trace = json.loads((inc_dir / "trace.perfetto.json").read_text())
+    assert any(ev.get("ph") == "X" for ev in trace["traceEvents"])
+    # a bad schema id is rejected on load
+    (inc_dir / "incident.json").write_text(
+        json.dumps({"schema": "nope"}))
+    with pytest.raises(ValueError, match="schema"):
+        soak.load_incident(inc_dir)
+
+
+# -- the bench-diff latency gate over v1.4 entries -------------------------
+
+
+def _lat_entry(label, scale=1.0, rate=40.0, n=40, device="cpu",
+               saturated=None):
+    lat_s = [0.002 * (1.0 + 0.05 * (i % 17)) * scale
+             for i in range(n)]
+    lat = timeseries.latency_summary(lat_s, arrival_rate=rate,
+                                     queue_depth_peak=3)
+    lat["samples_ms"] = [round(s * 1000.0, 6) for s in lat_s]
+    if saturated is not None:
+        lat["saturated"] = saturated
+    e = history.entry(
+        label=label, source="test",
+        result={"metric": "soak p95 job latency", "value": lat["p95_ms"],
+                "unit": "ms p95"},
+        extra={"engine": "async", "rep_times_s": [0.1]},
+        device_kind=device, latency=lat)
+    return history.validate_entry(e)
+
+
+def test_compare_latency_verdict_matrix():
+    a = _lat_entry("base")
+    # self-compare: zero delta is noise, never a regression
+    rep = regress.compare_latency(a, _lat_entry("again"))
+    assert rep["verdict"] == "noise"
+    assert rep["delta_pct"] == 0.0
+    # +20% uniform scaling over 40 samples/side: the rank test has
+    # power (PERF.md: >= 20 samples/side for a 1.2x shift) and the p95
+    # delta clears the practical bar
+    rep = regress.compare_latency(a, _lat_entry("slow", scale=1.2))
+    assert rep["verdict"] == "regression"
+    assert rep["p"] is not None and rep["p"] <= rep["alpha"]
+    assert rep["delta_pct"] == pytest.approx(20.0, abs=0.1)
+    # and the mirror image is an improvement
+    rep = regress.compare_latency(_lat_entry("slow", scale=1.2), a)
+    assert rep["verdict"] == "improvement"
+    # different offered load = different operating point
+    rep = regress.compare_latency(a, _lat_entry("othr", rate=80.0))
+    assert rep["verdict"] == "incomparable"
+    assert "arrival_rate_mismatch" in rep["flags"]
+    # no latency block on one side
+    bare = history.entry(
+        label="bare", source="test",
+        result={"metric": "soak p95 job latency", "value": 1.0,
+                "unit": "ms p95"},
+        extra={"engine": "async", "rep_times_s": [0.1]},
+        device_kind="cpu")
+    rep = regress.compare_latency(a, bare)
+    assert rep["verdict"] == "incomparable"
+    assert "bench.py --soak" in rep["detail"]
+    # cross-device latency is never compared
+    rep = regress.compare_latency(a, _lat_entry("tpu", device="tpu"))
+    assert rep["verdict"] == "incomparable"
+    # a saturated side is flagged, not silently averaged in
+    rep = regress.compare_latency(a, _lat_entry("sat", saturated=True))
+    assert "saturated:b" in rep["flags"]
+    # every verdict formats without raising
+    assert "bench-diff --latency" in regress.format_latency_report(rep)
+
+
+def test_compare_latency_low_power():
+    rep = regress.compare_latency(_lat_entry("a", n=2),
+                                  _lat_entry("b", n=2, scale=1.2))
+    assert "low_power" in rep["flags"]
+    assert rep["p"] is None

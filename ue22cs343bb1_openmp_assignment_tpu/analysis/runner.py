@@ -207,6 +207,7 @@ def run_jaxpr(quiet) -> dict:
     _print(quiet, f"   recompile guard: async cache={guard['async_cache_size']} "
                   f"sync cache={guard['sync_cache_size']} "
                   f"wave cache={guard['wave_cache_size']} "
+                  f"serve wave compiles={guard['serve_wave_compiles']} "
                   f"native reuse={guard['native_build_reused']}")
     return rep
 

@@ -628,6 +628,9 @@ def main(argv=None) -> int:
     if raw[:1] == ["serve"]:
         from ue22cs343bb1_openmp_assignment_tpu import serve as serve_mod
         return serve_mod.main(raw[1:])
+    if raw[:1] == ["soak"]:
+        from ue22cs343bb1_openmp_assignment_tpu import soak as soak_mod
+        return soak_mod.main(raw[1:])
     args = build_parser().parse_args(raw)
     if args.cpu:
         import jax

@@ -578,6 +578,18 @@ def build_bench_diff_parser() -> argparse.ArgumentParser:
                         "against a copy of A with its cost vector "
                         "scaled by (1 + PCT/100) — must come out a "
                         "regression (exit 4)")
+    p.add_argument("--latency", action="store_true",
+                   help="compare the recorded open-loop latency "
+                        "blocks (bench.py --soak) instead of rep "
+                        "times: Mann-Whitney U on the per-job "
+                        "samples_ms vectors, practical bar on the "
+                        "p95 delta; arrival-rate mismatch is "
+                        "incomparable")
+    p.add_argument("--synthetic-latency", type=float, metavar="PCT",
+                   help="self-test (implies --latency): compare A "
+                        "against a copy of A with its latency block "
+                        "scaled by (1 + PCT/100) — must come out a "
+                        "regression (exit 4)")
     p.add_argument("--min-effect", type=float, default=5.0,
                    metavar="PCT",
                    help="never flag deltas below this percent "
@@ -616,12 +628,17 @@ def cmd_bench_diff(args) -> int:
         return 2
 
     want_bytes = args.bytes or args.synthetic_bytes is not None
+    want_latency = args.latency or args.synthetic_latency is not None
     if args.bytes_tol is not None and not want_bytes:
         return fail("--bytes-tol only applies with --bytes")
-    if (args.synthetic_bytes is not None
-            and args.synthetic_slowdown is not None):
-        return fail("--synthetic-bytes and --synthetic-slowdown are "
-                    "exclusive")
+    if want_bytes and want_latency:
+        return fail("--bytes and --latency are exclusive")
+    synth = [n for n, v in (
+        ("--synthetic-slowdown", args.synthetic_slowdown),
+        ("--synthetic-bytes", args.synthetic_bytes),
+        ("--synthetic-latency", args.synthetic_latency)) if v is not None]
+    if len(synth) > 1:
+        return fail(" and ".join(synth) + " are exclusive")
     try:
         if args.against_last:
             if not args.history:
@@ -662,12 +679,25 @@ def cmd_bench_diff(args) -> int:
                     for k in (cost.get("kernels") or {}).values():
                         if k.get("hbm_bytes") is not None:
                             k["hbm_bytes"] = k["hbm_bytes"] * scale
+            elif args.synthetic_latency is not None:
+                scale = 1.0 + args.synthetic_latency / 100.0
+                entry_b = copy.deepcopy(entry_a)
+                entry_b["label"] = (f"{entry_a['label']}"
+                                    f"*{scale:g}L (synthetic)")
+                lat = entry_b.get("latency")
+                if isinstance(lat, dict):
+                    for k in ("p50_ms", "p95_ms", "p99_ms", "max_ms"):
+                        if lat.get(k) is not None:
+                            lat[k] = round(lat[k] * scale, 6)
+                    if lat.get("samples_ms") is not None:
+                        lat["samples_ms"] = [round(x * scale, 6)
+                                             for x in lat["samples_ms"]]
             elif args.b:
                 entry_b = _load_bench_entry(args.b)
             else:
                 return fail("provide capture B (or "
-                            "--synthetic-slowdown/--synthetic-bytes "
-                            "PCT)")
+                            "--synthetic-slowdown/--synthetic-bytes/"
+                            "--synthetic-latency PCT)")
     except (OSError, ValueError) as e:
         return fail(str(e))
 
@@ -676,6 +706,11 @@ def cmd_bench_diff(args) -> int:
                else args.bytes_tol)
         rep = regress.compare_cost(entry_a, entry_b, tol_pct=tol)
         fmt = regress.format_cost_report
+    elif want_latency:
+        rep = regress.compare_latency(entry_a, entry_b,
+                                      min_effect=args.min_effect / 100.0,
+                                      alpha=args.alpha)
+        fmt = regress.format_latency_report
     else:
         rep = regress.compare(entry_a, entry_b,
                               min_effect=args.min_effect / 100.0,

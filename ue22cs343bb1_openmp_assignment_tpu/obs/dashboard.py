@@ -66,9 +66,20 @@ def build_model(entries: List[dict],
                  if (e.get("config") or {}).get("kind") == "multichip"]
     serving = [{"label": e["label"], "value": float(e["value"]),
                 "slots": (e.get("serve") or {}).get("slots"),
+                "waves": (e.get("serve") or {}).get("waves"),
                 "padding_waste": (e.get("serve") or {}).get(
-                    "padding_waste")}
+                    "padding_waste"),
+                "mb_dropped": (e.get("serve") or {}).get("mb_dropped")}
                for e in entries if e.get("unit") == "jobs/sec"]
+    latency = [{"label": e["label"],
+                "value": float(e["latency"]["p95_ms"]),
+                "p50_ms": e["latency"]["p50_ms"],
+                "p99_ms": e["latency"]["p99_ms"],
+                "arrival_rate": e["latency"].get("arrival_rate"),
+                "queue_depth_peak": e["latency"].get(
+                    "queue_depth_peak"),
+                "saturated": e["latency"].get("saturated")}
+               for e in entries if isinstance(e.get("latency"), dict)]
     headline = [{"label": e["label"], "value": float(e["value"]),
                  "engine": (e.get("config") or {}).get("engine"),
                  "vs_target": float(e["value"]) / target}
@@ -112,7 +123,7 @@ def build_model(entries: List[dict],
             "cells": {f"{p}/{w}": v
                       for (p, w), v in sorted(cells.items())},
             "roofline": points, "scaling": scaling,
-            "serving": serving,
+            "serving": serving, "latency": latency,
             "n_entries": len(entries)}
 
 
@@ -297,6 +308,8 @@ td, th {{ border: 1px solid #d5dbdb; padding: 4px 10px;
              model["target"], "instrs/sec")}
 <h2>Serving throughput (jobs/sec)</h2>
 {_svg_series("serving", model["serving"], "value", None, "jobs/sec")}
+<h2>Open-loop job latency (p95 ms)</h2>
+{_svg_series("latency", model["latency"], "value", None, "ms p95")}
 <h2>bench-diff verdicts (adjacent pairs)</h2>
 {verdict_html}
 <h2>Coverage: protocol &times; workload</h2>
@@ -324,16 +337,39 @@ def render_markdown(model: dict) -> str:
                      f"| {h['value']:.4g} | {h['vs_target']:.2%} |")
     lines += ["", "## Serving throughput (jobs/sec)", ""]
     if model["serving"]:
-        lines += ["| entry | slots | jobs/sec | padding waste |",
-                  "|---|---:|---:|---:|"]
+        lines += ["| entry | slots | jobs/sec | padding waste "
+                  "| waves | mb dropped |",
+                  "|---|---:|---:|---:|---:|---:|"]
         for s in model["serving"]:
             slots = "?" if s["slots"] is None else f"{s['slots']}"
             pw = ("?" if s["padding_waste"] is None
                   else f"{s['padding_waste']:.1%}")
+            waves = "?" if s["waves"] is None else f"{s['waves']}"
+            mbd = ("?" if s["mb_dropped"] is None
+                   else f"{s['mb_dropped']}")
             lines.append(f"| {s['label']} | {slots} "
-                         f"| {s['value']:.4g} | {pw} |")
+                         f"| {s['value']:.4g} | {pw} "
+                         f"| {waves} | {mbd} |")
     else:
         lines.append("*no serving entries yet (bench.py --serve "
+                     "--record)*")
+    lines += ["", "## Open-loop job latency (p95 ms)", ""]
+    if model["latency"]:
+        lines += ["| entry | arrival rate | p50 ms | p95 ms "
+                  "| p99 ms | queue peak | saturated |",
+                  "|---|---:|---:|---:|---:|---:|---|"]
+        for l in model["latency"]:
+            rate = ("?" if l["arrival_rate"] is None
+                    else f"{l['arrival_rate']:g}/s")
+            qp = ("?" if l["queue_depth_peak"] is None
+                  else f"{l['queue_depth_peak']}")
+            sat = ("?" if l["saturated"] is None
+                   else ("yes" if l["saturated"] else "no"))
+            lines.append(f"| {l['label']} | {rate} "
+                         f"| {l['p50_ms']:.4g} | {l['value']:.4g} "
+                         f"| {l['p99_ms']:.4g} | {qp} | {sat} |")
+    else:
+        lines.append("*no latency entries yet (bench.py --soak "
                      "--record)*")
     lines += ["", "## bench-diff verdicts (adjacent pairs)", ""]
     if model["verdicts"]:

@@ -26,13 +26,14 @@ import os
 import subprocess
 from typing import List, Optional
 
-SCHEMA_ID = "cache-sim/bench/v1.3"
+SCHEMA_ID = "cache-sim/bench/v1.4"
 
 #: older schema ids; validate_entry accepts docs under any of these,
 #: with only the optional keys their version introduced
 SCHEMA_V1 = "cache-sim/bench/v1"
 SCHEMA_V11 = "cache-sim/bench/v1.1"
 SCHEMA_V12 = "cache-sim/bench/v1.2"
+SCHEMA_V13 = "cache-sim/bench/v1.3"
 
 #: entry keys, all always present (None marks "not captured")
 _TOP_KEYS = ("schema", "label", "source", "captured_at", "git_sha",
@@ -45,10 +46,14 @@ _TOP_KEYS = ("schema", "label", "source", "captured_at", "git_sha",
 #: vector (obs.roofline.cost_vector — the --bytes gate's input);
 #: v1.3 added the serving block ({slots, jobs, waves, padding_waste}
 #: from bench.py --serve — the jobs/sec rows next to the instrs/sec
-#: headline). Optional: absent and None both mean "not captured".
+#: headline); v1.4 added the latency block (p50/p95/p99 job latency
+#: + raw samples_ms from the open-loop soak harness, bench.py --soak —
+#: what bench-diff --latency adjudicates).
+#: Optional: absent and None both mean "not captured".
 _OPT_KEYS_V11 = ("device_kind", "hlo_fingerprint")
 _OPT_KEYS_V12 = _OPT_KEYS_V11 + ("cost",)
 _OPT_KEYS_V13 = _OPT_KEYS_V12 + ("serve",)
+_OPT_KEYS_V14 = _OPT_KEYS_V13 + ("latency",)
 
 #: required fields of a serve block (ints except padding_waste);
 #: optional extras "devices" (batch-mesh width of the wave) and
@@ -56,6 +61,17 @@ _OPT_KEYS_V13 = _OPT_KEYS_V12 + ("serve",)
 #: same block — absent in pre-multi-device captures, no schema bump
 _SERVE_KEYS = ("slots", "jobs", "waves", "padding_waste")
 _SERVE_OPT_KEYS = ("devices", "mb_dropped")
+
+#: required fields of a latency block: the nearest-rank percentiles
+#: (ms), the arrival rate the stream was released at (jobs/s — part of
+#: comparability: latencies at different offered loads never compare),
+#: and the admission-queue depth peak. Optional extras carry the raw
+#: per-job sample vector (what regress.compare_latency's Mann-Whitney
+#: test runs on) and the soak context.
+_LATENCY_KEYS = ("p50_ms", "p95_ms", "p99_ms", "arrival_rate",
+                 "queue_depth_peak")
+_LATENCY_OPT_KEYS = ("max_ms", "jobs", "samples_ms", "duration_s",
+                     "saturated", "drain_rate_jobs_per_s")
 
 
 # lint: host
@@ -78,8 +94,9 @@ def entry(label: str, source: str, result: dict, extra: dict,
           device_kind: Optional[str] = None,
           hlo_fingerprint: Optional[str] = None,
           cost: Optional[dict] = None,
-          serve: Optional[dict] = None) -> dict:
-    """Build a v1.3 entry from bench.py's two JSON lines.
+          serve: Optional[dict] = None,
+          latency: Optional[dict] = None) -> dict:
+    """Build a v1.4 entry from bench.py's two JSON lines.
 
     ``result`` is the stdout line ({metric, value, unit, vs_baseline});
     ``extra`` is the stderr line (engine, rep_times_s, quiescent, ...).
@@ -91,7 +108,11 @@ def entry(label: str, source: str, result: dict, extra: dict,
     ``cost`` is the deterministic roofline cost vector
     (obs.roofline.cost_vector) behind ``bench-diff --bytes``;
     ``serve`` is the batched-serving block ({slots, jobs, waves,
-    padding_waste}) attached to jobs/sec rows by ``bench.py --serve``.
+    padding_waste}) attached to jobs/sec rows by ``bench.py --serve``;
+    ``latency`` is the open-loop job-latency block ({p50_ms, p95_ms,
+    p99_ms, arrival_rate, queue_depth_peak} + the raw samples_ms
+    vector) attached by ``bench.py --soak`` — the input of
+    ``bench-diff --latency``.
     """
     doc = {
         "schema": SCHEMA_ID,
@@ -118,13 +139,14 @@ def entry(label: str, source: str, result: dict, extra: dict,
         "hlo_fingerprint": hlo_fingerprint,
         "cost": cost,
         "serve": serve,
+        "latency": latency,
     }
     return validate_entry(doc)
 
 
 # lint: host
 def validate_entry(doc: dict) -> dict:
-    """Check an entry against the schema (v1.3, or v1/v1.1/v1.2
+    """Check an entry against the schema (v1.4, or v1/v1.1/v1.2/v1.3
     unchanged for backward compatibility — an old doc may only carry
     the optional keys its version introduced); returns the doc, raises
     ValueError listing every violation (same contract as
@@ -134,7 +156,8 @@ def validate_entry(doc: dict) -> dict:
         raise ValueError(f"entry must be a dict, got {type(doc).__name__}")
     sid = doc.get("schema")
     allowed = _TOP_KEYS + (
-        _OPT_KEYS_V13 if sid == SCHEMA_ID
+        _OPT_KEYS_V14 if sid == SCHEMA_ID
+        else _OPT_KEYS_V13 if sid == SCHEMA_V13
         else _OPT_KEYS_V12 if sid == SCHEMA_V12
         else _OPT_KEYS_V11 if sid == SCHEMA_V11 else ())
     for k in _TOP_KEYS:
@@ -143,10 +166,11 @@ def validate_entry(doc: dict) -> dict:
     for k in doc:
         if k not in allowed:
             errs.append(f"unknown key: {k}")
-    if sid not in (SCHEMA_ID, SCHEMA_V12, SCHEMA_V11, SCHEMA_V1):
+    if sid not in (SCHEMA_ID, SCHEMA_V13, SCHEMA_V12, SCHEMA_V11,
+                   SCHEMA_V1):
         errs.append(f"schema must be {SCHEMA_ID!r} (or the "
-                    f"backward-compatible {SCHEMA_V12!r}/{SCHEMA_V11!r}"
-                    f"/{SCHEMA_V1!r}), got {sid!r}")
+                    f"backward-compatible {SCHEMA_V13!r}/{SCHEMA_V12!r}"
+                    f"/{SCHEMA_V11!r}/{SCHEMA_V1!r}), got {sid!r}")
     for k in _OPT_KEYS_V11:
         v = doc.get(k)
         if v is not None and (not isinstance(v, str) or not v):
@@ -186,6 +210,40 @@ def validate_entry(doc: dict) -> dict:
                                       or isinstance(x, bool) or x < 0):
                     errs.append(f"serve.{k} must be None or a "
                                 f"non-negative int, got {x!r}")
+    lat = doc.get("latency")
+    if lat is not None:
+        if not isinstance(lat, dict):
+            errs.append("latency must be None or a dict "
+                        f"{{{', '.join(_LATENCY_KEYS)}}}")
+        else:
+            for k in lat:
+                if k not in _LATENCY_KEYS + _LATENCY_OPT_KEYS:
+                    errs.append(f"latency has unknown key: {k}")
+            for k in ("p50_ms", "p95_ms", "p99_ms", "arrival_rate"):
+                x = lat.get(k)
+                if (not isinstance(x, (int, float))
+                        or isinstance(x, bool) or x < 0):
+                    errs.append(f"latency.{k} must be a non-negative "
+                                f"number, got {x!r}")
+            qd = lat.get("queue_depth_peak")
+            if (not isinstance(qd, int) or isinstance(qd, bool)
+                    or qd < 0):
+                errs.append("latency.queue_depth_peak must be a "
+                            f"non-negative int, got {qd!r}")
+            ps = [lat.get(k) for k in ("p50_ms", "p95_ms", "p99_ms")]
+            if (all(isinstance(p, (int, float))
+                    and not isinstance(p, bool) for p in ps)
+                    and not ps[0] <= ps[1] <= ps[2]):
+                errs.append("latency percentiles must be ordered "
+                            f"p50 <= p95 <= p99, got {ps}")
+            sm = lat.get("samples_ms")
+            if sm is not None and (
+                    not isinstance(sm, list)
+                    or any(not isinstance(x, (int, float))
+                           or isinstance(x, bool) or x < 0
+                           for x in sm)):
+                errs.append("latency.samples_ms must be None or a "
+                            "list of non-negative numbers")
     for k in ("label", "source", "metric", "unit"):
         if not isinstance(doc.get(k), str) or not doc.get(k):
             errs.append(f"{k} must be a non-empty string")
@@ -349,6 +407,7 @@ def ingest_multichip(path: str, label: Optional[str] = None) -> dict:
         "hlo_fingerprint": None,
         "cost": None,
         "serve": None,
+        "latency": None,
     }
     return validate_entry(doc)
 

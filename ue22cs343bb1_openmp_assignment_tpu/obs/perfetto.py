@@ -103,6 +103,80 @@ def span_flow_events(spans: List[dict]) -> List[dict]:
     return out
 
 
+#: serving-trace track layout (build_serve_trace): pid 0 is the
+#: admission queue; slot s renders as process PID_SLOT0 + s
+PID_QUEUE = 0
+PID_SLOT0 = 1
+
+#: seconds -> trace-event microseconds
+_US = 1e6
+
+
+# lint: host
+def serve_span_events(spans: List[dict]) -> List[dict]:
+    """Job-lifecycle spans (serve.SpanBook / obs.schema serve-trace) →
+    Perfetto slices plus flow arrows following each job across tracks.
+
+    Per span: a ``queued`` slice on the admission-queue track
+    (pid PID_QUEUE) from submit to admission, a ``run`` slice on the
+    job's slot track (pid PID_SLOT0 + slot, tid 0) from admission to
+    quiescence, and an ``extract`` slice (tid 1) from quiescence to
+    extraction — then a flow arrow ("s" on the queue slice, "t" on the
+    run slice, "f" binding-enclosing on the extract slice) stitching
+    the three into one visual chain per job. Flow ids are the span's
+    position in the input list, same convention as span_flow_events.
+    """
+    out = []
+    for fid, s in enumerate(spans):
+        pid = PID_SLOT0 + s["slot"]
+        t_sub = s["t_submit"] * _US
+        t_adm = s["t_admitted"] * _US
+        t_qui = s["t_quiescent"] * _US
+        t_ext = s["t_extracted"] * _US
+        args = {"wave": s["wave"], "slot": s["slot"],
+                "quiesced": s["quiesced"]}
+        out.append({"name": f"queued {s['job']}", "ph": "X",
+                    "cat": "serve", "pid": PID_QUEUE, "tid": 0,
+                    "ts": t_sub, "dur": max(t_adm - t_sub, 1.0),
+                    "args": args})
+        out.append({"name": f"run {s['job']}", "ph": "X",
+                    "cat": "serve", "pid": pid, "tid": TID_INSTR,
+                    "ts": t_adm, "dur": max(t_qui - t_adm, 1.0),
+                    "args": args})
+        out.append({"name": f"extract {s['job']}", "ph": "X",
+                    "cat": "serve", "pid": pid, "tid": TID_MSG,
+                    "ts": t_qui, "dur": max(t_ext - t_qui, 1.0),
+                    "args": args})
+        common = {"name": f"job {s['job']}", "cat": "serve", "id": fid}
+        out.append({"ph": "s", "pid": PID_QUEUE, "tid": 0,
+                    "ts": t_sub, **common})
+        out.append({"ph": "t", "pid": pid, "tid": TID_INSTR,
+                    "ts": t_adm, **common})
+        out.append({"ph": "f", "bp": "e", "pid": pid, "tid": TID_MSG,
+                    "ts": t_qui, **common})
+    return out
+
+
+# lint: host
+def build_serve_trace(spans: List[dict]) -> dict:
+    """Spans → a complete, validated serving trace-event document:
+    one ``queue`` process plus one process per batch slot used, each
+    slot with ``run``/``extract`` threads, job slices linked by flow
+    arrows (serve_span_events). Time unit: 1 us = 1 clock second/1e6
+    (the injected serving clock, see obs.clock)."""
+    events = [_meta(PID_QUEUE, 0, "process_name", "queue"),
+              _meta(PID_QUEUE, 0, "thread_name", "jobs")]
+    for slot in sorted({s["slot"] for s in spans}):
+        pid = PID_SLOT0 + slot
+        events.append(_meta(pid, 0, "process_name", f"slot {slot}"))
+        events.append(_meta(pid, TID_INSTR, "thread_name", "run"))
+        events.append(_meta(pid, TID_MSG, "thread_name", "extract"))
+    events.extend(serve_span_events(spans))
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"source": "cache-sim serve",
+                          "time_unit": "clock_us"}}
+
+
 # lint: host
 def build_trace(records: List[dict], num_nodes: int,
                 flows: List[dict] = None) -> dict:

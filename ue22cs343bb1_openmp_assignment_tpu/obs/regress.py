@@ -413,6 +413,166 @@ def format_cost_report(rep: dict) -> str:
 
 
 # lint: host
+def compare_latency(entry_a: dict, entry_b: dict,
+                    min_effect: float = DEFAULT_MIN_EFFECT,
+                    alpha: float = DEFAULT_ALPHA) -> dict:
+    """Compare two entries' open-loop latency blocks (A = baseline,
+    B = candidate; obs.history v1.4, recorded by ``bench.py --soak``).
+
+    Same two-bar decision as :func:`compare`, but over per-JOB latency
+    samples instead of per-rep wall times:
+
+    1. **Statistical**: one-sided Mann-Whitney U on the raw
+       ``samples_ms`` vectors (a soak yields tens of samples, so the
+       rank test has real power here). Skipped (p None, flagged
+       "low_power") when either side recorded fewer than 2 samples.
+    2. **Practical**: the p95 relative delta must exceed
+       ``min_effect`` — and ONLY ``min_effect``. The rep-spread term
+       of :func:`compare` is deliberately absent: job latencies across
+       a mixed open-loop stream spread structurally (different
+       workloads, different queue positions), so "spread" here is
+       signal, not machine wobble; the rank test carries the noise
+       question.
+
+    Incomparable when metrics, device kinds, or **arrival rates**
+    differ (latency under different offered load measures a different
+    operating point), or when either side has no latency block.
+    """
+    flags = []
+    if entry_a.get("metric") != entry_b.get("metric"):
+        return {
+            "verdict": "incomparable",
+            "detail": (f"metric mismatch: {entry_a.get('metric')!r} vs "
+                       f"{entry_b.get('metric')!r}"),
+            "a": {"label": entry_a.get("label")},
+            "b": {"label": entry_b.get("label")},
+            "flags": ["metric_mismatch"],
+        }
+    dev_a = entry_a.get("device_kind")
+    dev_b = entry_b.get("device_kind")
+    if dev_a and dev_b and dev_a != dev_b:
+        return {
+            "verdict": "incomparable",
+            "detail": (f"incomparable: different device "
+                       f"({dev_a!r} vs {dev_b!r})"),
+            "a": {"label": entry_a.get("label"), "device_kind": dev_a},
+            "b": {"label": entry_b.get("label"), "device_kind": dev_b},
+            "flags": ["device_mismatch"],
+        }
+    lat_a = entry_a.get("latency")
+    lat_b = entry_b.get("latency")
+    for side, lat, e in (("a", lat_a, entry_a), ("b", lat_b, entry_b)):
+        if not isinstance(lat, dict):
+            return {
+                "verdict": "incomparable",
+                "detail": (f"no latency block on side {side} "
+                           f"({e.get('label')!r}) — record it with "
+                           "bench.py --soak"),
+                "a": {"label": entry_a.get("label")},
+                "b": {"label": entry_b.get("label")},
+                "flags": ["no_latency"],
+            }
+    rate_a = lat_a.get("arrival_rate")
+    rate_b = lat_b.get("arrival_rate")
+    if rate_a != rate_b:
+        return {
+            "verdict": "incomparable",
+            "detail": (f"arrival-rate mismatch: {rate_a!r} vs "
+                       f"{rate_b!r} jobs/s — latency at different "
+                       "offered loads measures different operating "
+                       "points"),
+            "a": {"label": entry_a.get("label"), "arrival_rate": rate_a},
+            "b": {"label": entry_b.get("label"), "arrival_rate": rate_b},
+            "flags": ["arrival_rate_mismatch"],
+        }
+    for side, lat in (("a", lat_a), ("b", lat_b)):
+        if lat.get("saturated"):
+            flags.append(f"saturated:{side}")
+    p95_a = float(lat_a["p95_ms"])
+    p95_b = float(lat_b["p95_ms"])
+    if p95_a <= 0:
+        return {
+            "verdict": "incomparable",
+            "detail": "baseline p95 is zero",
+            "a": {"label": entry_a.get("label")},
+            "b": {"label": entry_b.get("label")},
+            "flags": flags + ["no_latency"],
+        }
+    delta = (p95_b - p95_a) / p95_a
+    threshold = min_effect
+
+    samp_a = list(lat_a.get("samples_ms") or [])
+    samp_b = list(lat_b.get("samples_ms") or [])
+    p = u = method = None
+    p_impr = None
+    if len(samp_a) >= 2 and len(samp_b) >= 2:
+        slower = mann_whitney_u(samp_a, samp_b)  # H1: b latencies larger
+        faster = mann_whitney_u(samp_b, samp_a)  # H1: a latencies larger
+        u, method = slower["u"], slower["method"]
+        p, p_impr = slower["p"], faster["p"]
+        if 1.0 / math.comb(len(samp_a) + len(samp_b),
+                           min(len(samp_a), len(samp_b))) > alpha:
+            flags.append("low_power")
+            p = p_impr = None
+    else:
+        flags.append("low_power")
+
+    if delta >= threshold and (p is None or p <= alpha):
+        verdict = "regression"
+    elif -delta >= threshold and (p_impr is None or p_impr <= alpha):
+        verdict = "improvement"
+    else:
+        verdict = "noise"
+
+    def _side(e, lat, samples):
+        return {"label": e.get("label"),
+                "p50_ms": lat.get("p50_ms"),
+                "p95_ms": lat.get("p95_ms"),
+                "p99_ms": lat.get("p99_ms"),
+                "queue_depth_peak": lat.get("queue_depth_peak"),
+                "samples": len(samples)}
+
+    return {
+        "verdict": verdict,
+        "delta_pct": round(delta * 100.0, 3),
+        "threshold_pct": round(threshold * 100.0, 3),
+        "arrival_rate": rate_a,
+        "p": p,
+        "u": u,
+        "method": method,
+        "alpha": alpha,
+        "flags": flags,
+        "a": _side(entry_a, lat_a, samp_a),
+        "b": _side(entry_b, lat_b, samp_b),
+    }
+
+
+# lint: host
+def format_latency_report(rep: dict) -> str:
+    """Glanceable lines for the latency gate (JSON is the machine
+    surface)."""
+    a, b = rep.get("a", {}), rep.get("b", {})
+    lines = [(f"bench-diff --latency: {a.get('label', '?')} -> "
+              f"{b.get('label', '?')}: {rep['verdict'].upper()}")]
+    if rep["verdict"] == "incomparable":
+        lines.append(f"  {rep.get('detail', '')}")
+    else:
+        lines.append(
+            f"  p95 {a.get('p95_ms')}ms -> {b.get('p95_ms')}ms "
+            f"({rep['delta_pct']:+.2f}%), practical bar "
+            f"{rep['threshold_pct']:.2f}% "
+            f"@ {rep.get('arrival_rate')} jobs/s "
+            f"({a.get('samples')} vs {b.get('samples')} job samples)")
+        if rep.get("p") is not None:
+            lines.append(
+                f"  Mann-Whitney one-sided p={rep['p']:.4f} "
+                f"({rep['method']}, alpha={rep['alpha']})")
+    if rep.get("flags"):
+        lines.append("  flags: " + ", ".join(rep["flags"]))
+    return "\n".join(lines)
+
+
+# lint: host
 def format_report(rep: dict) -> str:
     """Two-to-four human lines for terminal output (JSON is the
     machine surface; this is the glanceable one)."""

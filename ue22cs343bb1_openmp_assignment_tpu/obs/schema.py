@@ -49,6 +49,12 @@ v1 → v1.1: the only change is the optional ``txn_latency`` block.
 ``txn_latency`` is rejected — the key did not exist in v1), so every
 archived report and golden keeps validating.
 
+This module also owns the serving-layer trace schema
+(``cache-sim/serve-trace/v1``, :func:`validate_serve_trace`): the
+Dapper-style job-lifecycle span docs serve.py and the soak harness
+emit. It lives here so every schema'd observability doc validates
+through one dependency-free module.
+
 v1.1 → v1.2: adds the required top-level ``mb_dropped`` counter — the
 mailbox-overflow silent drop (SURVEY quirk 6, ``assignment.c:754-762``)
 pulled up from ``messages.dropped_overflow`` so drop-sensitive
@@ -295,4 +301,117 @@ def validate(doc: dict) -> dict:
         errs.append("extra must be a dict")
     if errs:
         raise ValueError("invalid metrics report:\n  " + "\n  ".join(errs))
+    return doc
+
+
+# -- serving trace: job-lifecycle spans ------------------------------------
+
+SERVE_TRACE_SCHEMA_ID = "cache-sim/serve-trace/v1"
+
+#: every span field, all always present (Dapper-style lifecycle:
+#: submit -> queued -> admitted(wave, slot) -> running -> quiescent ->
+#: extracted, assembled host-side by serve.SpanBook under the injected
+#: clock). The three segment durations MUST sum exactly to e2e_s —
+#: they are computed from the timestamps in one place (SpanBook), so
+#: the decomposition holds by construction, and validate_serve_trace
+#: re-checks it.
+SPAN_KEYS = ("job", "wave", "slot", "quiesced",
+             "t_submit", "t_queued", "t_admitted", "t_running",
+             "t_quiescent", "t_extracted",
+             "queue_wait_s", "run_s", "extract_s", "e2e_s")
+
+#: the lifecycle timestamps in causal order (monotone per span)
+_SPAN_TS_ORDER = ("t_submit", "t_queued", "t_admitted", "t_running",
+                  "t_quiescent", "t_extracted")
+
+_TRACE_TOP_KEYS = ("schema", "clock", "jobs", "latency", "spans")
+
+
+# lint: host
+def _validate_span(i: int, s, errs) -> None:
+    if not isinstance(s, dict):
+        errs.append(f"span {i}: not a dict")
+        return
+    for k in SPAN_KEYS:
+        if k not in s:
+            errs.append(f"span {i}: missing key {k}")
+            return
+    for k in set(s) - set(SPAN_KEYS):
+        errs.append(f"span {i}: unknown key {k}")
+    if not isinstance(s["job"], str) or not s["job"]:
+        errs.append(f"span {i}: job must be a non-empty string")
+    for k in ("wave", "slot"):
+        v = s[k]
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errs.append(f"span {i}: {k} must be a non-negative int, "
+                        f"got {v!r}")
+    if not isinstance(s["quiesced"], bool):
+        errs.append(f"span {i}: quiesced must be bool")
+    ts = []
+    for k in _SPAN_TS_ORDER:
+        v = s[k]
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            errs.append(f"span {i}: {k} must be a number, got {v!r}")
+            return
+        ts.append(float(v))
+    if any(b < a for a, b in zip(ts, ts[1:])):
+        errs.append(f"span {i} ({s['job']}): lifecycle timestamps not "
+                    f"monotone: {list(zip(_SPAN_TS_ORDER, ts))}")
+    for k in ("queue_wait_s", "run_s", "extract_s", "e2e_s"):
+        v = s[k]
+        if (not isinstance(v, (int, float)) or isinstance(v, bool)
+                or v < 0):
+            errs.append(f"span {i}: {k} must be a non-negative number, "
+                        f"got {v!r}")
+            return
+    if s["e2e_s"] != s["queue_wait_s"] + s["run_s"] + s["extract_s"]:
+        errs.append(f"span {i} ({s['job']}): e2e_s != queue_wait_s + "
+                    f"run_s + extract_s (the decomposition must hold "
+                    f"exactly, by construction)")
+
+
+# lint: host
+def validate_serve_trace(doc: dict) -> dict:
+    """Structural check of a ``cache-sim/serve-trace/v1`` doc
+    (serve.serve_trace_doc / the soak harness): schema id, clock kind,
+    per-span lifecycle monotonicity, and the exact span decomposition
+    invariant. Same contract as :func:`validate`."""
+    errs = []
+    if not isinstance(doc, dict):
+        raise ValueError(f"trace must be a dict, got {type(doc).__name__}")
+    if doc.get("schema") != SERVE_TRACE_SCHEMA_ID:
+        errs.append(f"schema must be {SERVE_TRACE_SCHEMA_ID!r}, "
+                    f"got {doc.get('schema')!r}")
+    for k in _TRACE_TOP_KEYS:
+        if k not in doc:
+            errs.append(f"missing key: {k}")
+    for k in doc:
+        if k not in _TRACE_TOP_KEYS:
+            errs.append(f"unknown key: {k}")
+    if doc.get("clock") not in ("monotonic", "virtual"):
+        errs.append(f"clock must be monotonic|virtual, "
+                    f"got {doc.get('clock')!r}")
+    spans = doc.get("spans")
+    if not isinstance(spans, list):
+        errs.append("spans must be a list")
+        spans = []
+    if doc.get("jobs") != len(spans):
+        errs.append(f"jobs ({doc.get('jobs')!r}) != len(spans) "
+                    f"({len(spans)})")
+    for i, s in enumerate(spans):
+        _validate_span(i, s, errs)
+    lat = doc.get("latency")
+    if lat is not None:
+        if not isinstance(lat, dict):
+            errs.append("latency must be None or a dict")
+        else:
+            ps = [lat.get(k) for k in ("p50_ms", "p95_ms", "p99_ms")]
+            if any(not isinstance(p, (int, float))
+                   or isinstance(p, bool) or p < 0 for p in ps):
+                errs.append("latency p50_ms/p95_ms/p99_ms must be "
+                            "non-negative numbers")
+            elif not ps[0] <= ps[1] <= ps[2]:
+                errs.append(f"latency percentiles not monotone: {ps}")
+    if errs:
+        raise ValueError("invalid serve trace:\n  " + "\n  ".join(errs))
     return doc

@@ -5,11 +5,20 @@ on device (counter deltas, per-type dequeues, queue-depth watermarks,
 directory occupancy, latency-histogram deltas) — this module turns the
 fetched [T, ...] arrays into named JSON-ready series and compact
 summaries for ``cache-sim stats --timeseries``.
+
+The serving layer adds a second, host-sampled series family: the soak
+harness (soak.py) samples admission-queue depth and slot occupancy at
+every scheduler turn; :func:`serve_series` /
+:func:`summarize_serve_series` shape those samples the same JSON-ready
+way, and :func:`percentile` / :func:`latency_summary` turn a job
+latency vector into the p50/p95/p99 block that rides bench history
+(obs.history schema v1.4) and the serve-trace doc.
 """
 
 from __future__ import annotations
 
-from typing import Dict
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -67,3 +76,80 @@ def summarize(telem: Dict) -> dict:
         } if t["dir_occupancy"].shape[0] else None,
         "lat_hist_total": t["lat_hist"].sum(axis=0).astype(int).tolist(),
     }
+
+
+# -- serving-side (host-sampled) series ------------------------------------
+
+
+# lint: host
+def serve_series(samples: Sequence[Tuple[float, int, int]]) -> dict:
+    """Soak scheduler samples [(t_s, queue_depth, slots_busy), ...] →
+    ``{"samples": n, "series": {"t_s", "queue_depth", "slots_busy"}}``
+    — the same named-series shape as :func:`to_series`, but sampled on
+    the host at scheduler turns (admission boundaries), not per cycle
+    on device."""
+    return {
+        "samples": len(samples),
+        "series": {
+            "t_s": [float(t) for t, _, _ in samples],
+            "queue_depth": [int(q) for _, q, _ in samples],
+            "slots_busy": [int(b) for _, _, b in samples],
+        },
+    }
+
+
+# lint: host
+def summarize_serve_series(samples: Sequence[Tuple[float, int, int]]) -> dict:
+    """Peaks + endpoint of a serve_series sample list (the queue-depth
+    numbers the backpressure verdict and the history latency block
+    read)."""
+    depths = [int(q) for _, q, _ in samples]
+    busy = [int(b) for _, _, b in samples]
+    return {
+        "samples": len(samples),
+        "queue_depth_peak": max(depths, default=0),
+        "queue_depth_final": depths[-1] if depths else 0,
+        "slots_busy_peak": max(busy, default=0),
+    }
+
+
+# lint: host
+def percentile(xs: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile (q in [0, 100]) of a non-empty sample.
+
+    Nearest-rank on purpose: every reported percentile is a latency
+    that actually happened to a job, never an interpolated value —
+    which also keeps virtual-clock soak docs byte-identical (no
+    float interpolation to wobble)."""
+    if not xs:
+        raise ValueError("percentile of an empty sample")
+    if not 0 <= q <= 100:
+        raise ValueError(f"q must be in [0, 100], got {q}")
+    s = sorted(xs)
+    rank = max(1, math.ceil(q / 100.0 * len(s)))
+    return float(s[rank - 1])
+
+
+# lint: host
+def latency_summary(lat_s: Sequence[float],
+                    arrival_rate: Optional[float] = None,
+                    queue_depth_peak: Optional[int] = None) -> Optional[dict]:
+    """Job end-to-end latencies (seconds) → the p50/p95/p99 latency
+    block (milliseconds) that rides the serve-trace doc and — with
+    ``arrival_rate`` / ``queue_depth_peak`` — bench history v1.4.
+    None for an empty sample (a soak that released no jobs)."""
+    if not len(lat_s):
+        return None
+    ms: List[float] = [float(x) * 1e3 for x in lat_s]
+    doc = {
+        "p50_ms": percentile(ms, 50),
+        "p95_ms": percentile(ms, 95),
+        "p99_ms": percentile(ms, 99),
+        "max_ms": max(ms),
+        "jobs": len(ms),
+    }
+    if arrival_rate is not None:
+        doc["arrival_rate"] = float(arrival_rate)
+    if queue_depth_peak is not None:
+        doc["queue_depth_peak"] = int(queue_depth_peak)
+    return doc

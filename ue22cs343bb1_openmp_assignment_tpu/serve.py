@@ -52,12 +52,12 @@ from __future__ import annotations
 import dataclasses
 import json
 import pathlib
-import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.obs.clock import MonotonicClock
 from ue22cs343bb1_openmp_assignment_tpu.types import Op
 
 SCHEMA_ID = "cache-sim/serve/v1"
@@ -268,6 +268,98 @@ def solo_dumps(spec: JobSpec, chunk: int = 32, max_cycles: int = 100_000,
             for d in golden.state_to_dumps(cfg, final)]
 
 
+class SpanBook:
+    """Host-side assembly of Dapper-style job-lifecycle spans.
+
+    One span per job, advanced through the lifecycle ``submit ->
+    queued -> admitted(wave, slot) -> running -> quiescent ->
+    extracted`` by the wave loop (serve) or the open-loop scheduler
+    (soak). Every timestamp defaults to ``clock.now()`` of the ONE
+    injected clock (obs.clock) — the same time base as the wave
+    records — and the three segment durations are computed here, in
+    one place, from the lifecycle timestamps::
+
+        queue_wait_s = t_admitted  - t_submit
+        run_s        = t_quiescent - t_admitted
+        extract_s    = t_extracted - t_quiescent
+        e2e_s        = queue_wait_s + run_s + extract_s
+
+    so the decomposition invariant (segments sum EXACTLY to e2e, the
+    obs.txntrace convention) holds by construction —
+    obs.schema.validate_serve_trace re-checks it on every emitted doc.
+    """
+
+    # lint: host
+    def __init__(self, clock) -> None:
+        self.clock = clock
+        self._open: Dict[str, dict] = {}
+        self._done: List[dict] = []
+
+    # lint: host
+    def _t(self, t: Optional[float]) -> float:
+        return float(self.clock.now() if t is None else t)
+
+    # lint: host
+    def submit(self, job: str, t: Optional[float] = None) -> None:
+        t = self._t(t)
+        self._open[job] = {"job": job, "t_submit": t, "t_queued": t}
+
+    # lint: host
+    def queued(self, job: str, t: Optional[float] = None) -> None:
+        self._open[job]["t_queued"] = self._t(t)
+
+    # lint: host
+    def admitted(self, job: str, wave: int, slot: int,
+                 t: Optional[float] = None) -> None:
+        s = self._open[job]
+        s["wave"] = int(wave)
+        s["slot"] = int(slot)
+        s["t_admitted"] = self._t(t)
+
+    # lint: host
+    def running(self, job: str, t: Optional[float] = None) -> None:
+        self._open[job]["t_running"] = self._t(t)
+
+    # lint: host
+    def quiescent(self, job: str, ok: bool,
+                  t: Optional[float] = None) -> None:
+        s = self._open[job]
+        s["quiesced"] = bool(ok)
+        s["t_quiescent"] = self._t(t)
+
+    # lint: host
+    def extracted(self, job: str, t: Optional[float] = None) -> None:
+        s = self._open.pop(job)
+        s["t_extracted"] = self._t(t)
+        s["queue_wait_s"] = s["t_admitted"] - s["t_submit"]
+        s["run_s"] = s["t_quiescent"] - s["t_admitted"]
+        s["extract_s"] = s["t_extracted"] - s["t_quiescent"]
+        s["e2e_s"] = s["queue_wait_s"] + s["run_s"] + s["extract_s"]
+        self._done.append(s)
+
+    # lint: host
+    def spans(self) -> List[dict]:
+        """Closed spans, in extraction order."""
+        return list(self._done)
+
+
+# lint: host
+def serve_trace_doc(spans: List[dict], clock_kind: str) -> dict:
+    """Closed spans → the validated ``cache-sim/serve-trace/v1`` doc
+    (the machine surface; the Perfetto rendering of the same spans is
+    obs.perfetto.build_serve_trace)."""
+    from ue22cs343bb1_openmp_assignment_tpu.obs import schema, timeseries
+    doc = {
+        "schema": schema.SERVE_TRACE_SCHEMA_ID,
+        "clock": clock_kind,
+        "jobs": len(spans),
+        "latency": timeseries.latency_summary(
+            [s["e2e_s"] for s in spans]),
+        "spans": spans,
+    }
+    return schema.validate_serve_trace(doc)
+
+
 # lint: host
 def _host_quiescent(host) -> np.ndarray:
     """SimState.quiescent() per batch slot, in numpy over the one
@@ -296,7 +388,8 @@ def batch_shardings(mesh, bstate):
 def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
           slot_trace_len: Optional[int] = None, chunk: int = 32,
           max_cycles: int = 100_000, queue_capacity: int = 64,
-          out_dir=None, quiet: bool = True, devices: int = 1) -> dict:
+          out_dir=None, quiet: bool = True, devices: int = 1,
+          clock=None) -> dict:
     """Run a stream of jobs through fixed-shape batch waves.
 
     Jobs are grouped by protocol (each protocol is its own wave
@@ -315,6 +408,16 @@ def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
     construction). Admission (``set_state``) and extraction are
     unchanged — jit keeps the sharding layout across waves. Requires
     ``slots % devices == 0``.
+
+    ALL serving timing reads the injected ``clock`` (obs.clock;
+    default the production MonotonicClock) — wave ``wall_s`` and the
+    per-job lifecycle spans share that one time base, and a
+    VirtualClock makes every timestamp (hence the whole trace doc)
+    deterministic. Spans are assembled host-side (SpanBook) and ride
+    the summary as ``doc["trace"]``, a validated
+    ``cache-sim/serve-trace/v1`` doc; with ``out_dir`` the Perfetto
+    rendering (flow arrows per job, obs.perfetto.build_serve_trace)
+    lands at ``<out_dir>/trace.perfetto.json``.
 
     Returns the ``cache-sim/serve/v1`` summary doc; per-job results
     (dumps + metrics docs) are in ``doc["jobs"]`` and, when ``out_dir``
@@ -344,9 +447,15 @@ def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
                 f"slots={slots} does not shard over devices={devices}")
         mesh = Mesh(avail[:devices], ("batch",))
 
-    t_start = time.perf_counter()
+    clock = clock if clock is not None else MonotonicClock()
+    t_start = clock.now()
+    book = SpanBook(clock)
     by_proto: Dict[str, List[JobSpec]] = {}
     for s in specs:
+        # the whole stream is present at serve() entry (closed loop) —
+        # every job submits and queues at t_start; the open-loop
+        # arrival schedule is the soak harness's job (soak.py)
+        book.submit(s.name, t_start)
         by_proto.setdefault(s.protocol, []).append(s)
 
     out_path = pathlib.Path(out_dir) if out_dir is not None else None
@@ -379,6 +488,7 @@ def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
                     job_config(spec, queue_capacity), spec)[3]))
                 states.append(build_job_state(
                     scfg, job_config(spec, queue_capacity), spec))
+                book.admitted(spec.name, wave=len(waves) + 1, slot=i)
             else:
                 states.append(empty)
         bstate = st.stack_states(states)
@@ -387,14 +497,19 @@ def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
 
         while any(o is not None for o in occupant):
             real = sum(real_by_slot)
-            t0 = time.perf_counter()
+            t0 = clock.now()
+            for o in occupant:
+                if o is not None:
+                    book.running(o.name, t0)
             bstate = step.run_wave_to_quiescence(
                 scfg, bstate, chunk, max_cycles, phase)
             # ONE device->host transfer per wave; per-job extraction
             # below is numpy slicing on this copy
             host = jax.device_get(bstate)
             quiet_mask = _host_quiescent(host)
-            wave_s = time.perf_counter() - t0
+            clock.on_wave()
+            t_wave_end = clock.now()
+            wave_s = t_wave_end - t0
             budget = slots * N * T
             finished = [o.name for o in occupant if o is not None]
             # quirk 6 surfaced: per-slot mailbox-overflow drop counts
@@ -440,6 +555,7 @@ def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
                 jcfg = job_config(spec, queue_capacity)
                 doc = job_metrics_doc(jstate)
                 ok = bool(quiet_mask[i])
+                book.quiescent(spec.name, ok, t_wave_end)
                 job_docs[spec.name] = {
                     "spec": dataclasses.asdict(spec),
                     "quiesced": ok,
@@ -453,6 +569,7 @@ def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
                     golden.write_dumps(jcfg, view, jdir)
                     (jdir / "metrics.json").write_text(
                         json.dumps(job_docs[spec.name], indent=2) + "\n")
+                book.extracted(spec.name)
                 # swap out; admit the next queued job into this slot
                 if queue:
                     nxt = queue.pop(0)
@@ -461,6 +578,8 @@ def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
                         job_config(nxt, queue_capacity), nxt)[3]))
                     bstate = st.set_state(bstate, i, build_job_state(
                         scfg, job_config(nxt, queue_capacity), nxt))
+                    book.admitted(nxt.name, wave=len(waves) + 1,
+                                  slot=i)
                 else:
                     # no replacement: leave the finished (quiescent =
                     # fixpoint) or budget-dead (cycle >= max_cycles =
@@ -470,8 +589,9 @@ def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
                     occupant[i] = None
                     real_by_slot[i] = 0
 
-    wall = time.perf_counter() - t_start
+    wall = clock.now() - t_start
     n_jobs = len(job_docs)
+    spans = book.spans()
     doc = {
         "schema": SCHEMA_ID,
         "slots": slots,
@@ -486,11 +606,15 @@ def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
         "padding_waste": (1.0 - real_total / slot_budget_total
                           if slot_budget_total else 0.0),
         "jobs": job_docs,
+        "trace": serve_trace_doc(spans, clock.kind),
     }
     if out_path is not None:
         out_path.mkdir(parents=True, exist_ok=True)
         (out_path / "serve_summary.json").write_text(
             json.dumps(doc, indent=2) + "\n")
+        from ue22cs343bb1_openmp_assignment_tpu.obs import perfetto
+        trace = perfetto.validate_trace(perfetto.build_serve_trace(spans))
+        perfetto.write_trace(str(out_path / "trace.perfetto.json"), trace)
     return doc
 
 

@@ -1,0 +1,480 @@
+"""Open-loop soak harness: latency under load, measured honestly.
+
+``serve.serve`` is closed-loop — the whole job stream is present at
+entry, so it measures *throughput* (jobs/sec) but cannot say anything
+about latency under a live arrival process. This module is the
+open-loop complement (ROADMAP serving-observability): a deterministic,
+seeded Poisson stream of mixed-traffic jobs (:func:`soak_stream`) is
+*released* at its scheduled arrival times regardless of how the
+machine is doing — arrivals never wait for completions, which is what
+makes the measurement free of coordinated omission (PERF.md): a job's
+``queue_wait_s`` starts at its **scheduled** arrival, so a stalled
+server shows up as queue growth and fat latency tails instead of
+silently slowing the load generator down.
+
+The scheduler is a turn loop over the same wave machinery as serve:
+
+1. release every arrival whose scheduled time has passed into the
+   admission queue (span ``submit`` stamped at the scheduled time);
+2. admit queued jobs into free batch slots (``state.set_state`` — the
+   wave jit stays warm, same one-compile contract as serve);
+3. sample ``(t, queue_depth, slots_busy)`` — the host-side time series
+   behind the backpressure verdict (obs.timeseries.serve_series);
+4. run one batched wave to quiescence, stamp spans, extract and free
+   every finished slot. When no slot is occupied the clock instead
+   jumps/sleeps to the next scheduled arrival.
+
+All timing reads the injected clock (obs.clock): under a
+:class:`~ue22cs343bb1_openmp_assignment_tpu.obs.clock.VirtualClock`
+every timestamp is a pure function of the schedule, so two soaks with
+the same seed emit byte-identical ``cache-sim/serve-trace/v1`` docs —
+the determinism gate in tests/test_soak.py.
+
+The summary doc carries the p50/p95/p99 job-latency block
+(nearest-rank, obs.timeseries.latency_summary), the queue/occupancy
+series, padding-waste and ``mb_dropped`` totals, and a backpressure
+verdict (arrival rate vs measured drain rate). ``--slo p95=<ms>``
+turns the run into a gate: a breach exits :data:`EXIT_SLO_BREACH` (4,
+the obs.regress regression code) after dumping a flight-recorder-style
+incident directory (:func:`dump_incident`) with the slowest jobs'
+spans, the queue time series, and the Perfetto rendering of the whole
+soak.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ue22cs343bb1_openmp_assignment_tpu.obs.clock import (MonotonicClock,
+                                                          VirtualClock)
+from ue22cs343bb1_openmp_assignment_tpu.serve import (
+    DEFAULT_MIX, JobSpec, SpanBook, build_job_arrays, build_job_state,
+    job_config, protocol_phase, serve_trace_doc, slot_config,
+    _host_quiescent, _STATE_CACHE)
+
+SCHEMA_ID = "cache-sim/soak/v1"
+INCIDENT_SCHEMA_ID = "cache-sim/soak-incident/v1"
+
+#: process exit code on an SLO breach — deliberately the same code
+#: obs.regress uses for a bench regression, so CI treats both alike
+EXIT_SLO_BREACH = 4
+
+#: latency percentiles an ``--slo`` spec may bound
+SLO_METRICS = ("p50", "p95", "p99")
+
+#: slowest jobs carried (with full spans) into an incident doc
+INCIDENT_SLOWEST = 5
+
+
+# lint: host
+def soak_stream(arrival_rate: float, duration_s: float, nodes: int = 4,
+                trace_len: int = 8, protocol: str = "mesi",
+                mix: Tuple[str, ...] = DEFAULT_MIX,
+                seed: int = 0) -> List[Tuple[float, JobSpec]]:
+    """Deterministic open-loop arrival schedule: a seeded Poisson
+    process (exponential inter-arrival gaps at ``arrival_rate`` jobs/s)
+    over ``duration_s`` seconds of mixed-traffic jobs — the same
+    workload mix and seed convention as serve.mixed_jobs, plus an
+    arrival offset per job. Same (rate, duration, seed) → the same
+    schedule, byte for byte."""
+    if arrival_rate <= 0:
+        raise ValueError(f"arrival_rate must be > 0, got {arrival_rate}")
+    if duration_s <= 0:
+        raise ValueError(f"duration_s must be > 0, got {duration_s}")
+    rng = np.random.default_rng(seed)
+    arrivals: List[Tuple[float, JobSpec]] = []
+    t = 0.0
+    i = 0
+    while True:
+        t += float(rng.exponential(1.0 / arrival_rate))
+        if t >= duration_s:
+            break
+        arrivals.append((t, JobSpec(
+            name=f"job{i:03d}", workload=mix[i % len(mix)], nodes=nodes,
+            trace_len=trace_len, seed=i, protocol=protocol)))
+        i += 1
+    return arrivals
+
+
+# lint: host
+def soak(arrivals, slots: int = 4, slot_nodes: Optional[int] = None,
+         slot_trace_len: Optional[int] = None, chunk: int = 32,
+         max_cycles: int = 100_000, queue_capacity: int = 64,
+         arrival_rate: Optional[float] = None, clock=None,
+         quiet: bool = True) -> dict:
+    """Run an open-loop arrival schedule ``[(t_offset_s, JobSpec)]``
+    through the batched wave machinery; returns the
+    ``cache-sim/soak/v1`` summary doc (latency block, queue/occupancy
+    series, backpressure verdict, embedded serve-trace doc).
+
+    One protocol per soak: the wave stepper's message phase is a
+    static jit argument, so a mixed-protocol stream would interleave
+    two wave sequences and the drain-rate verdict would compare apples
+    to oranges.
+    """
+    import sys
+
+    import jax
+
+    from ue22cs343bb1_openmp_assignment_tpu import state as st
+    from ue22cs343bb1_openmp_assignment_tpu.obs import timeseries
+    from ue22cs343bb1_openmp_assignment_tpu.ops import step
+
+    arrivals = sorted(arrivals, key=lambda a: a[0])
+    specs = [s for _, s in arrivals]
+    if not specs:
+        raise ValueError("soak needs at least one arrival")
+    protocols = sorted({s.protocol for s in specs})
+    if len(protocols) > 1:
+        raise ValueError(f"soak streams are single-protocol, "
+                         f"got {protocols}")
+    protocol = protocols[0]
+    phase = protocol_phase(protocol)
+    scfg = slot_config(specs, slot_nodes, slot_trace_len,
+                       queue_capacity, protocol)
+    N, T = scfg.num_nodes, scfg.max_instrs
+
+    clock = clock if clock is not None else MonotonicClock()
+    t_start = clock.now()
+    book = SpanBook(clock)
+    pending = [(t_start + dt, s) for dt, s in arrivals]
+    queue: List[JobSpec] = []
+
+    if ("empty", scfg) not in _STATE_CACHE:
+        _STATE_CACHE[("empty", scfg)] = st.init_state(scfg)
+    empty = _STATE_CACHE[("empty", scfg)]
+    occupant: List[Optional[JobSpec]] = [None] * slots
+    real_by_slot = [0] * slots
+    bstate = st.stack_states([empty] * slots)
+
+    samples: List[Tuple[float, int, int]] = []
+    waves: List[dict] = []
+    job_docs: Dict[str, dict] = {}
+    slot_budget_total = 0
+    real_total = 0
+    mb_dropped_total = 0
+
+    while pending or queue or any(o is not None for o in occupant):
+        now = clock.now()
+        # open-loop release: spans stamp the SCHEDULED arrival time,
+        # not the release-check time — queue_wait accrues from the
+        # moment the load generator meant the job to exist
+        while pending and pending[0][0] <= now:
+            t_arr, spec = pending.pop(0)
+            book.submit(spec.name, t_arr)
+            queue.append(spec)
+        for i in range(slots):
+            if occupant[i] is None and queue:
+                spec = queue.pop(0)
+                occupant[i] = spec
+                real_by_slot[i] = int(np.sum(build_job_arrays(
+                    job_config(spec, queue_capacity), spec)[3]))
+                bstate = st.set_state(bstate, i, build_job_state(
+                    scfg, job_config(spec, queue_capacity), spec))
+                book.admitted(spec.name, wave=len(waves) + 1, slot=i)
+        busy = sum(1 for o in occupant if o is not None)
+        samples.append((now - t_start, len(queue), busy))
+        if busy == 0:
+            # idle: nothing to run, jump/sleep to the next arrival
+            if pending:
+                clock.sleep(pending[0][0] - now)
+            continue
+
+        real = sum(real_by_slot)
+        t0 = clock.now()
+        for o in occupant:
+            if o is not None:
+                book.running(o.name, t0)
+        bstate = step.run_wave_to_quiescence(
+            scfg, bstate, chunk, max_cycles, phase)
+        host = jax.device_get(bstate)
+        quiet_mask = _host_quiescent(host)
+        clock.on_wave()
+        t_wave_end = clock.now()
+        budget = slots * N * T
+        occ = np.array([o is not None for o in occupant])
+        wave_dropped = int(np.sum(
+            np.asarray(host.metrics.msgs_dropped)[occ]))
+        waves.append({
+            "protocol": protocol,
+            "jobs": [o.name for o in occupant if o is not None],
+            "wall_s": t_wave_end - t0,
+            "slot_instr_budget": budget,
+            "real_instrs": real,
+            "padding_waste": 1.0 - real / budget,
+            "mb_dropped": wave_dropped,
+        })
+        slot_budget_total += budget
+        real_total += real
+        mb_dropped_total += wave_dropped
+        if wave_dropped and not quiet:
+            print(f"soak: WARNING wave {len(waves)} dropped "
+                  f"{wave_dropped} mailbox messages", file=sys.stderr)
+
+        for i, spec in enumerate(occupant):
+            if spec is None:
+                continue
+            ok = bool(quiet_mask[i])
+            book.quiescent(spec.name, ok, t_wave_end)
+            job_docs[spec.name] = {
+                "quiesced": ok,
+                "wave": len(waves),
+                "slot": i,
+                "cycles": int(np.asarray(st.index_state(host, i).cycle)),
+            }
+            book.extracted(spec.name)
+            # the finished (quiescent = fixpoint) state stays in place
+            # until the slot is refilled — same contract as serve
+            occupant[i] = None
+            real_by_slot[i] = 0
+
+    wall = clock.now() - t_start
+    spans = book.spans()
+    series_summary = timeseries.summarize_serve_series(samples)
+    latency = timeseries.latency_summary(
+        [s["e2e_s"] for s in spans], arrival_rate=arrival_rate,
+        queue_depth_peak=series_summary["queue_depth_peak"])
+    # drain rate over BUSY time (waves actually running), not wall:
+    # wall includes idle gaps waiting for the next arrival, which
+    # would make an under-loaded machine look slow — busy-time drain
+    # is the service capacity the arrival rate is compared against
+    busy_s = sum(w["wall_s"] for w in waves)
+    drain = len(spans) / busy_s if busy_s > 0 else 0.0
+    doc = {
+        "schema": SCHEMA_ID,
+        "slots": slots,
+        "arrival_rate": arrival_rate,
+        "jobs_total": len(spans),
+        "jobs_quiesced": sum(1 for d in job_docs.values()
+                             if d["quiesced"]),
+        "wave_count": len(waves),
+        "wall_s": wall,
+        "busy_s": busy_s,
+        "drain_rate_jobs_per_s": drain,
+        "padding_waste": (1.0 - real_total / slot_budget_total
+                          if slot_budget_total else 0.0),
+        "mb_dropped": mb_dropped_total,
+        "latency": latency,
+        "series": timeseries.serve_series(samples),
+        "series_summary": series_summary,
+        "verdict": backpressure_verdict(arrival_rate, drain,
+                                        series_summary),
+        "jobs": job_docs,
+        "waves": waves,
+        "trace": serve_trace_doc(spans, clock.kind),
+    }
+    return doc
+
+
+# lint: host
+def backpressure_verdict(arrival_rate: Optional[float], drain: float,
+                         series_summary: dict) -> dict:
+    """Saturation call: the machine is saturated when jobs arrive
+    faster than the measured drain rate — the queue then grows for as
+    long as the arrival window lasts (its peak depth is reported
+    alongside so the operator sees how far behind it got)."""
+    saturated = bool(arrival_rate is not None and drain > 0
+                     and arrival_rate > drain)
+    return {
+        "saturated": saturated,
+        "arrival_rate": arrival_rate,
+        "drain_rate_jobs_per_s": drain,
+        "queue_depth_peak": series_summary["queue_depth_peak"],
+    }
+
+
+# lint: host
+def parse_slo(spec: str) -> Dict[str, float]:
+    """``"p95=5,p99=20"`` → ``{"p95_ms": 5.0, "p99_ms": 20.0}``;
+    bounds are milliseconds on the percentiles in SLO_METRICS."""
+    out: Dict[str, float] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad SLO term {part!r} (want p95=<ms>)")
+        k, v = part.split("=", 1)
+        k = k.strip()
+        if k not in SLO_METRICS:
+            raise ValueError(f"unknown SLO metric {k!r} "
+                             f"(one of {SLO_METRICS})")
+        try:
+            ms = float(v)
+        except ValueError:
+            raise ValueError(f"bad SLO bound {v!r} for {k}")
+        if ms <= 0:
+            raise ValueError(f"SLO bound for {k} must be > 0, got {ms}")
+        out[k + "_ms"] = ms
+    if not out:
+        raise ValueError(f"empty SLO spec {spec!r}")
+    return out
+
+
+# lint: host
+def check_slo(latency: Optional[dict],
+              slo: Dict[str, float]) -> List[dict]:
+    """Breach list (empty = all bounds hold). A soak that completed no
+    jobs has no latency block and cannot breach."""
+    if latency is None:
+        return []
+    return [{"metric": k, "limit_ms": limit,
+             "observed_ms": latency[k]}
+            for k, limit in sorted(slo.items()) if latency[k] > limit]
+
+
+# lint: host
+def dump_incident(out_dir, doc: dict, breaches: List[dict]) -> dict:
+    """Write a self-contained SLO-breach incident directory (the
+    flight-recorder convention, obs.flight): ``incident.json`` — the
+    breaches, the latency block, the backpressure verdict, the
+    ``INCIDENT_SLOWEST`` slowest jobs' full spans, and the queue-depth
+    time series — plus ``trace.perfetto.json``, the Perfetto rendering
+    of every job's lifecycle with flow arrows. Returns the incident
+    doc."""
+    from ue22cs343bb1_openmp_assignment_tpu.obs import perfetto
+    out_dir = str(out_dir)
+    os.makedirs(out_dir, exist_ok=True)
+    spans = doc["trace"]["spans"]
+    trace = perfetto.validate_trace(perfetto.build_serve_trace(spans))
+    perfetto.write_trace(
+        os.path.join(out_dir, "trace.perfetto.json"), trace)
+    slowest = sorted(spans, key=lambda s: (-s["e2e_s"], s["job"]))
+    inc = {
+        "schema": INCIDENT_SCHEMA_ID,
+        "reason": "slo-breach",
+        "breaches": breaches,
+        "arrival_rate": doc["arrival_rate"],
+        "jobs_total": doc["jobs_total"],
+        "latency": doc["latency"],
+        "verdict": doc["verdict"],
+        "slowest_jobs": slowest[:INCIDENT_SLOWEST],
+        "series": doc["series"],
+        "series_summary": doc["series_summary"],
+        "files": sorted(["incident.json", "trace.perfetto.json"]),
+    }
+    with open(os.path.join(out_dir, "incident.json"), "w") as f:
+        json.dump(inc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return inc
+
+
+# lint: host
+def load_incident(incident_dir) -> dict:
+    """Read and schema-check a soak incident doc."""
+    path = os.path.join(str(incident_dir), "incident.json")
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != INCIDENT_SCHEMA_ID:
+        raise ValueError(f"{path}: schema must be "
+                         f"{INCIDENT_SCHEMA_ID!r}, got "
+                         f"{doc.get('schema')!r}")
+    for k in ("reason", "breaches", "latency", "slowest_jobs",
+              "series", "files"):
+        if k not in doc:
+            raise ValueError(f"{path}: missing key {k!r}")
+    return doc
+
+
+# lint: host
+def main(argv=None) -> int:
+    """``cache-sim soak`` entry point."""
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="cache-sim soak",
+        description="open-loop soak: release a seeded mixed-traffic "
+                    "job stream at a fixed arrival rate and measure "
+                    "p50/p95/p99 job latency, queue depth, and "
+                    "saturation")
+    ap.add_argument("--arrival-rate", type=float, default=20.0,
+                    help="jobs per second released (default 20)")
+    ap.add_argument("--duration", type=float, default=2.0,
+                    help="arrival window in seconds (default 2); the "
+                         "run drains fully after the window closes")
+    ap.add_argument("--slots", type=int, default=4,
+                    help="batch slots per wave (default 4)")
+    ap.add_argument("--nodes", type=int, default=4,
+                    help="nodes per job (default 4)")
+    ap.add_argument("--trace-len", type=int, default=8,
+                    help="instructions per node per job (default 8)")
+    ap.add_argument("--protocol", default="mesi",
+                    help="coherence protocol for the stream "
+                         "(default mesi)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="arrival-schedule + workload seed (default 0)")
+    ap.add_argument("--chunk", type=int, default=32)
+    ap.add_argument("--max-cycles", type=int, default=100_000)
+    ap.add_argument("--queue-capacity", type=int, default=64)
+    ap.add_argument("--virtual-clock", action="store_true",
+                    help="run on the deterministic VirtualClock "
+                         "(byte-identical trace docs; tests/CI)")
+    ap.add_argument("--wave-s", type=float, default=1e-3,
+                    help="virtual seconds charged per wave under "
+                         "--virtual-clock (default 1e-3)")
+    ap.add_argument("--slo", default=None,
+                    help='latency SLO, e.g. "p95=5,p99=20" (ms); a '
+                         f'breach exits {EXIT_SLO_BREACH} and dumps '
+                         'an incident dir')
+    ap.add_argument("--incident-dir", default="soak_incident",
+                    help="where an SLO breach dumps its incident "
+                         "(default ./soak_incident)")
+    ap.add_argument("--out", default=None,
+                    help="write the full soak doc as JSON here")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full soak doc as JSON")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force JAX_PLATFORMS=cpu (set before jax "
+                         "import)")
+    args = ap.parse_args(argv)
+    if args.cpu:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    slo = parse_slo(args.slo) if args.slo else None
+
+    clock = (VirtualClock(wave_s=args.wave_s) if args.virtual_clock
+             else MonotonicClock())
+    arrivals = soak_stream(args.arrival_rate, args.duration,
+                           nodes=args.nodes, trace_len=args.trace_len,
+                           protocol=args.protocol, seed=args.seed)
+    doc = soak(arrivals, slots=args.slots, chunk=args.chunk,
+               max_cycles=args.max_cycles,
+               queue_capacity=args.queue_capacity,
+               arrival_rate=args.arrival_rate, clock=clock,
+               quiet=False)
+    if args.out:
+        pathlib.Path(args.out).write_text(
+            json.dumps(doc, indent=2) + "\n")
+    if args.json:
+        print(json.dumps(doc, indent=2))
+    else:
+        lat = doc["latency"]
+        v = doc["verdict"]
+        lat_str = ("no jobs completed" if lat is None else
+                   f"p50={lat['p50_ms']:.2f}ms p95={lat['p95_ms']:.2f}ms "
+                   f"p99={lat['p99_ms']:.2f}ms")
+        print(f"soak: {doc['jobs_quiesced']}/{doc['jobs_total']} jobs "
+              f"quiesced in {doc['wave_count']} waves, {lat_str}, "
+              f"queue_peak={v['queue_depth_peak']}, "
+              f"drain={v['drain_rate_jobs_per_s']:.2f} jobs/s, "
+              f"{'SATURATED' if v['saturated'] else 'keeping up'}")
+    if slo:
+        breaches = check_slo(doc["latency"], slo)
+        if breaches:
+            import sys
+            dump_incident(args.incident_dir, doc, breaches)
+            for b in breaches:
+                print(f"soak: SLO BREACH {b['metric']} "
+                      f"{b['observed_ms']:.2f}ms > limit "
+                      f"{b['limit_ms']:.2f}ms", file=sys.stderr)
+            print(f"soak: incident dumped to {args.incident_dir}",
+                  file=sys.stderr)
+            return EXIT_SLO_BREACH
+    return 0 if doc["jobs_quiesced"] == doc["jobs_total"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
