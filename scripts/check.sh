@@ -17,6 +17,13 @@
 # wall-clock enforced by timeout(1); diverging traces are ddmin-shrunk
 # in the same invocation.
 #
+# The litmus smoke enumerates a fast subset of the memory-consistency
+# suite (analysis/litmus.py) under MESI: each test's reachable outcome
+# set must EXACTLY equal its declarative allowed set (forbidden
+# observed or allowed unreachable both fail). Also ≤30 s boxed; the
+# full matrix incl. MOESI/MESIF and the 4-node IRIW shape is the slow
+# test tier (tests/test_litmus.py).
+#
 # The table smoke runs the declarative-protocol-table prong: the four
 # static verify passes (totality, determinism, ownership conservation,
 # stability + anchor provenance) over the MESI/MOESI/MESIF tables, then
@@ -58,6 +65,10 @@ python -m ue22cs343bb1_openmp_assignment_tpu.analysis --jaxpr ${ANALYZE_ARGS:-}
 
 timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.analysis \
     --skip-model-check --skip-lint --fuzz "${FUZZ_N:-16}" --seed 0
+
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.analysis \
+    --litmus --litmus-tests corr,coww,mp,sb --skip-model-check \
+    --skip-lint
 
 timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.analysis \
     --table --skip-model-check --skip-lint

@@ -1,0 +1,310 @@
+"""Memory-consistency litmus suite + axiomatic checker acceptance.
+
+* DSL round-trip — every builtin test concretizes cleanly, seeds as a
+  litmus-tagged FuzzCase, and survives the fixture loader
+  (analysis/fixtures.py) byte-for-byte, tag included.
+* exact outcome sets — for the classic shapes under MESI the model
+  checker's exhaustively enumerated outcome set EXACTLY equals the
+  DSL's allowed set (both directions: no forbidden outcome reachable,
+  no allowed outcome unreachable).
+* axiomatic witness — the po/rf/co/fr reconstruction flags a
+  hand-built coherence-violating event list with a rendered
+  SC-per-location cycle, and stays silent on the SC version.
+* consistency mutants — each seeded bug in CONSISTENCY_MUTATIONS is
+  killed by BOTH referees: the litmus enumeration observes a forbidden
+  outcome, and the fuzzer's consistency oracle (analysis/axioms.py)
+  produces an sc_cycle witness on the pinned interleaving, which ddmin
+  shrinks and the fixture loader replays.
+* CLI — `cache-sim analyze --litmus` honors the 0/1/3 exit contract.
+
+The full protocol matrix (MOESI/MESIF) and the 4-node IRIW shape are
+slow-tier; scripts/check.sh time-boxes the fast MESI subset.
+"""
+
+import dataclasses
+
+import pytest
+
+from ue22cs343bb1_openmp_assignment_tpu.analysis import (axioms, fixtures,
+                                                         fuzz, litmus)
+from ue22cs343bb1_openmp_assignment_tpu.analysis import shrink as sh
+from ue22cs343bb1_openmp_assignment_tpu.analysis.mutations import (
+    CONSISTENCY_MUTATIONS)
+
+#: concrete MESI outcome sets (x0=1, y0=20, A=65, B=66) — hand-derived
+#: from SC + the engine's blocking frontend, locked by enumeration
+EXACT_MESI = {
+    "corr": {(1, 1), (1, 65), (65, 65)},
+    "mp": {(20, 1), (20, 65), (66, 65)},
+    "sb": {(20, 65), (66, 1), (66, 65)},
+    "mp_reload": {(1, 20, 1), (1, 20, 65), (65, 20, 65),
+                  (1, 66, 65), (65, 66, 65)},
+    "mp_upgrade": {(1, 1, 20, 1), (1, 1, 20, 65), (1, 65, 20, 65),
+                   (1, 1, 66, 65), (1, 65, 66, 65)},
+}
+
+
+# -- DSL ------------------------------------------------------------------
+
+
+def test_builtin_suite_well_formed():
+    assert set(litmus.SEED_ORDER) == set(litmus.BUILTIN)
+    for name, t in litmus.BUILTIN.items():
+        assert t.name == name
+        cfg = litmus.litmus_cfg(t.num_nodes)
+        conc = litmus.concretize(t, cfg)
+        assert len(conc["traces"]) == t.num_nodes
+        for prog, tr in zip(t.programs, conc["traces"]):
+            assert len(prog) == len(tr)
+        n_reads = sum(1 for p in t.programs for op in p
+                      if op[0] == "R")
+        for out in conc["allowed"]:
+            assert len(out) == n_reads + len(conc["final_addrs"])
+            assert all(isinstance(v, int) for v in out)
+        # 0 is never a litmus init or write value: a literal 0 in an
+        # allowed set only ever marks a sanctioned blind-WRITEBACK
+        # ghost (module docstring) — and only IRIW has those
+        if name != "iriw":
+            assert all(0 not in out for out in conc["allowed"]), name
+
+
+def test_dsl_round_trips_through_fixture_loader(tmp_path):
+    for i, name in enumerate(litmus.SEED_ORDER):
+        case = litmus.to_fuzz_case(litmus.BUILTIN[name], i)
+        assert case.litmus == name
+        d = str(tmp_path / name)
+        fixtures.write_fixture(d, case, "ok", "litmus seed")
+        back = fixtures.load_case(d)
+        assert back == case
+        assert back.litmus == name
+    # mutation must drop the tag: a mutated program is no longer the
+    # litmus test, so its allowed set must not be applied
+    import numpy as np
+    rng = np.random.default_rng(0)
+    seed = litmus.seed_cases(1)[0]
+    assert fuzz.mutate_case(rng, seed, 99).litmus is None
+
+
+def test_seed_cases_order_and_ids():
+    seeds = litmus.seed_cases(4)
+    assert [c.litmus for c in seeds] == list(litmus.SEED_ORDER[:4])
+    assert [c.case_id for c in seeds] == [0, 1, 2, 3]
+
+
+# -- exact enumeration under MESI -----------------------------------------
+
+
+@pytest.mark.parametrize("name", sorted(EXACT_MESI))
+def test_exact_outcome_set_mesi(name):
+    rep = litmus.enumerate_outcomes(litmus.BUILTIN[name], "mesi")
+    assert rep["ok"], (rep["unexpected"], rep["unobserved"],
+                       rep["violations"])
+    assert set(map(tuple, rep["observed"])) == EXACT_MESI[name]
+    assert set(map(tuple, rep["allowed"])) == EXACT_MESI[name]
+
+
+# -- axiomatic checker on hand-built events -------------------------------
+
+
+def _ev(node, idx, t, kind, addr, obs, val=None):
+    e = {"node": node, "idx": idx, "t": t, "kind": kind,
+         "addr": addr, "obs": obs}
+    if val is not None:
+        e["val"] = val
+    return e
+
+
+def test_axioms_flag_hand_built_coherence_violation():
+    """CoRR backwards: a reader sees the new value then the init —
+    rf -> po-loc -> fr must close into an SC-per-location cycle."""
+    cfg = litmus.litmus_cfg(2)
+    events = [_ev(0, 0, 5, "W", 0x01, 65, val=65),
+              _ev(1, 0, 10, "R", 0x01, 65),
+              _ev(1, 1, 12, "R", 0x01, 1)]
+    rep = axioms.check_events(cfg, events)
+    checks = [v["check"] for v in rep["violations"]]
+    assert "sc_per_location" in checks, rep
+    wit = [v for v in rep["violations"]
+           if v["check"] == "sc_per_location"][0]["witness"]
+    assert len(wit) == 3 and any("-rf->" in w for w in wit) \
+        and any("-fr->" in w for w in wit), wit
+    # the SC-ordered version of the same history is clean
+    ok_events = [_ev(1, 0, 3, "R", 0x01, 1),
+                 _ev(0, 0, 5, "W", 0x01, 65, val=65),
+                 _ev(1, 1, 12, "R", 0x01, 65)]
+    rep = axioms.check_events(cfg, ok_events)
+    assert not rep["violations"] and rep["pristine"], rep
+
+
+# -- consistency mutants: killed by both referees -------------------------
+
+
+@pytest.mark.parametrize("mutation", sorted(CONSISTENCY_MUTATIONS))
+def test_consistency_mutant_killed_by_enumeration(mutation):
+    fn, tname, _check, _d, _p = CONSISTENCY_MUTATIONS[mutation]
+    rep = litmus.enumerate_outcomes(litmus.BUILTIN[tname], "mesi",
+                                    message_phase=fn)
+    assert not rep["ok"], f"{mutation} survived litmus {tname}"
+    assert rep["unexpected"], rep
+    assert all(tuple(o) not in EXACT_MESI[tname]
+               for o in rep["unexpected"])
+
+
+@pytest.mark.parametrize("mutation", sorted(CONSISTENCY_MUTATIONS))
+def test_consistency_mutant_oracle_witness_shrinks_and_replays(
+        mutation, tmp_path):
+    """On the pinned interleaving the axiomatic oracle raises the
+    documented check with a rendered cycle; the witness case ddmin-
+    shrinks under a same-check predicate and replays through the
+    fixture loader."""
+    fn, tname, check, delays, periods = CONSISTENCY_MUTATIONS[mutation]
+    case = dataclasses.replace(
+        litmus.to_fuzz_case(litmus.BUILTIN[tname], 0),
+        delays=delays, periods=periods)
+
+    rep = axioms.check_case(case, message_phase=fn)
+    vio = [v for v in rep["violations"] if v["check"] == check]
+    assert vio, (mutation, rep["violations"], rep["skips"])
+    assert vio[0]["witness"], vio
+    # the fuzzer's consistency rung sees the same thing
+    verdict, detail = fuzz._consistency_join(case, fn, None)
+    assert verdict == "consistency" and check in detail, (verdict,
+                                                         detail)
+
+    cache = {}
+
+    def pred(items):
+        key = tuple(items)
+        if key not in cache:
+            c = sh._rebuild(case, list(items))
+            r = axioms.check_case(c, message_phase=fn)
+            cache[key] = any(v["check"] == check
+                             for v in r["violations"])
+        return cache[key]
+
+    items = sh._flatten(case)
+    assert pred(items)
+    kept = sh.ddmin(list(items), pred)
+    assert pred(kept) and len(kept) <= len(items)
+    small = sh._rebuild(case, kept)
+
+    # replayable witness: fixture round-trip preserves the recorded
+    # verdict under the mutant engine (run_case's earlier state rung
+    # may fire first — the recorded verdict is whatever the full
+    # oracle chain says, and replay must reproduce it exactly)
+    res = fuzz.run_case(small, fn)
+    assert res["verdict"] != "ok"
+    d = str(tmp_path / mutation)
+    fixtures.write_fixture(d, small, res["verdict"], res["detail"])
+    rr = fixtures.replay(d, fn)
+    assert rr["reproduced"], (rr["verdict"], rr["expected_verdict"])
+
+
+def test_membership_check_flags_forbidden_outcome():
+    fn, tname, _check, delays, periods = \
+        CONSISTENCY_MUTATIONS["skip_inv_fanout"]
+    test = litmus.BUILTIN[tname]
+    case = dataclasses.replace(litmus.to_fuzz_case(test, 0),
+                               delays=delays, periods=periods)
+    cfg = case.config()
+    rep = axioms.check_case(case, message_phase=fn)
+    finding = litmus.check_run_outcome(test, cfg, rep["events"],
+                                       rep["final_state"])
+    assert finding is not None and "forbidden" in finding["detail"]
+    # the clean engine on the same schedule stays in the allowed set
+    rep = axioms.check_case(case)
+    assert litmus.check_run_outcome(test, cfg, rep["events"],
+                                    rep["final_state"]) is None
+
+
+@pytest.mark.slow
+def test_litmus_seeded_fuzz_smoke():
+    """Fixed-seed smoke: the litmus seeds ride in the corpus and no
+    forbidden outcome / consistency violation appears on the shipped
+    handlers."""
+    rep = fuzz.fuzz(8, seed=0)
+    assert rep["ok"], rep["findings"]
+    assert rep["verdicts"].get("ok") == 8
+
+
+# -- CLI exit contract ----------------------------------------------------
+
+
+def test_cli_exit_code_matrix(tmp_path):
+    import json
+
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import runner
+    out = str(tmp_path / "rep.json")
+    base = ["--skip-model-check", "--skip-lint", "-q"]
+    # 0: clean pass
+    assert runner.main(["--litmus", "--litmus-tests", "corr,coww",
+                        "--json", out] + base) == 0
+    doc = json.load(open(out))
+    assert doc["litmus"]["mesi"]["corr"]["ok"] is True
+    # 3: budget exhausted, no finding
+    assert runner.main(["--litmus", "--litmus-tests", "corr",
+                        "--max-states", "10"] + base) == 3
+    # 1: the seeded consistency mutant reaches a forbidden outcome;
+    # the clean sibling test in the same run stays green and does not
+    # mask the finding
+    assert runner.main(["--litmus", "--litmus-tests",
+                        "mp_upgrade,corr", "--mutation",
+                        "skip_inv_fanout"] + base) == 1
+    # usage errors
+    with pytest.raises(SystemExit):
+        runner.main(["--litmus", "--litmus-tests", "nope"] + base)
+    with pytest.raises(SystemExit):
+        # a consistency mutation outside the litmus/fuzz prongs is
+        # rejected with guidance, not silently ignored
+        runner.main(["--mutation", "stale_fill_from_invalid"])
+
+
+def test_dashboard_litmus_matrix_renders():
+    from ue22cs343bb1_openmp_assignment_tpu.obs import dashboard
+    suite = {"mesi": {
+        "corr": {"ok": True, "observed": [[1, 1]], "allowed": [[1, 1]],
+                 "unexpected": []},
+        "mp": {"ok": False, "observed": [[20, 1], [66, 1]],
+               "allowed": [[20, 1]], "unexpected": [[66, 1]]},
+        "sb": {"ok": None, "budget_exhausted": True,
+               "detail": "> 10 states"}}}
+    m = dashboard.build_model([], litmus=suite)
+    assert [c["test"] for c in m["litmus"]] == ["corr", "mp", "sb"]
+    html = dashboard.render_html(m)
+    md = dashboard.render_markdown(m)
+    assert "Litmus matrix" in html and "ok (1/1)" in html
+    assert "FAIL (2/1)" in md and "budget" in md
+    # empty model keeps the placeholder (and the golden svg count)
+    m0 = dashboard.build_model([])
+    assert m0["litmus"] == []
+    assert "no litmus report loaded" in dashboard.render_html(m0)
+
+
+# -- slow tier: IRIW + the protocol matrix --------------------------------
+
+
+@pytest.mark.slow
+def test_iriw_exact_under_mesi():
+    rep = litmus.enumerate_outcomes(litmus.BUILTIN["iriw"], "mesi",
+                                    max_states=600_000)
+    assert rep["ok"], (rep["unexpected"], rep["unobserved"])
+    assert len(rep["observed"]) == len(rep["allowed"]) == 32
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("protocol", ["moesi", "mesif"])
+def test_protocol_sweep(protocol):
+    names = [n for n in litmus.SEED_ORDER if n != "iriw"]
+    out = litmus.run_suite(tests=names, protocols=(protocol,),
+                           max_states=600_000)
+    bad = {n: (r["unexpected"], r["unobserved"])
+           for n, r in out[protocol].items() if not r["ok"]}
+    assert not bad, bad
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("protocol", ["moesi", "mesif"])
+def test_iriw_protocol_variants(protocol):
+    rep = litmus.enumerate_outcomes(litmus.BUILTIN["iriw"], protocol,
+                                    max_states=600_000)
+    assert rep["ok"], (rep["unexpected"], rep["unobserved"])

@@ -95,6 +95,13 @@ def _targets(cfg: SystemConfig) -> dict:
         "step.cycle": lambda s: step.cycle(cfg, s),
         "mailbox.dequeue": lambda s: mailbox.dequeue(cfg, s),
         "step.run_cycles[8]": lambda s: step.run_cycles(cfg, s, 8),
+        # the litmus/axiomatic capture path: the ledger planes (incl.
+        # the obs_retire/obs_val observed-value tape the consistency
+        # checker replays, with_obs=True) must trace as cheaply as the
+        # bare runner — pure gathers of values the cycle already
+        # computes
+        "step.run_cycles_ledger[8]":
+            lambda s: step.run_cycles_ledger(cfg, s, 8, None, True),
         "step.run_to_quiescence":
             lambda s: step.run_to_quiescence(cfg, s, 64),
         "pallas_round.routed_ops": lambda s: _routed_ops_probe(),

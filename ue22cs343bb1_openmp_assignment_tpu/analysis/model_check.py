@@ -79,8 +79,9 @@ from ue22cs343bb1_openmp_assignment_tpu.state import (LAT_BUCKETS, MB_BV0,
                                                       MB_TYPE, Metrics,
                                                       SimState, init_state)
 from ue22cs343bb1_openmp_assignment_tpu.types import (CACHE_STATE_NAMES,
-                                                      DIR_STATE_NAMES, Msg,
-                                                      Op)
+                                                      DIR_STATE_NAMES,
+                                                      CacheState, DirState,
+                                                      Msg, Op)
 
 # blocks the frontend issue gate for non-acting nodes (state.issue_delay)
 BIG_DELAY = 1 << 20
@@ -259,6 +260,13 @@ class AState:
     cur_val: tuple
     queues: tuple       # [N] tuples of (type, sender, addr, value,
                         #                second, dirstate, bv_word)
+    # per-node tuple of observed READ values in program order — the
+    # litmus "registers". Empty (the default) outside litmus mode;
+    # ModelChecker(track_obs=True) seeds it with N empty tuples and
+    # appends at each read-retire boundary. Part of state identity:
+    # outcomes are PATH properties, so two machine states that differ
+    # only in what their reads already returned must not merge.
+    obs: tuple = ()
 
 
 def _t2(arr) -> tuple:
@@ -384,14 +392,16 @@ def _apply_perm(cfg: SystemConfig, g: _Perm, a: AState) -> AState:
         dir_state=tuple(dir_state), dir_bitvec=tuple(dir_bitvec),
         instr_idx=tuple(instr_idx), waiting=tuple(waiting),
         cur_op=tuple(cur_op), cur_addr=tuple(cur_addr),
-        cur_val=tuple(cur_val), queues=tuple(queues))
+        cur_val=tuple(cur_val), queues=tuple(queues),
+        # observed values are data, not node ids — only the rows move
+        obs=tuple(a.obs[g.inv_sig[j]] for j in range(N)) if a.obs else ())
 
 
 def _akey(a: AState) -> tuple:
     """Total order over AStates for orbit canonicalization."""
     return (a.cache_addr, a.cache_val, a.cache_state, a.memory,
             a.dir_state, a.dir_bitvec, a.instr_idx, a.waiting,
-            a.cur_op, a.cur_addr, a.cur_val, a.queues)
+            a.cur_op, a.cur_addr, a.cur_val, a.queues, a.obs)
 
 
 def symmetry_group(scope: Scope, a0: AState) -> list:
@@ -429,10 +439,21 @@ class ModelChecker:
     """
 
     def __init__(self, scope: Scope, message_phase=None,
-                 max_states: int = 50_000):
+                 max_states: int = 50_000, track_obs: bool = False,
+                 final_addrs: tuple = ()):
+        """``track_obs=True`` switches on litmus mode: every READ retire
+        appends its observed value to the node's AState.obs register
+        tape, and the report gains an ``outcomes`` key — the sorted set
+        of (read observations in node-major program order + final
+        values of ``final_addrs``) over all quiescent terminal states,
+        closed under the symmetry group. Off by default: the default
+        report stays byte-identical (obs stays the empty tuple, which
+        canonicalizes away)."""
         self.scope = scope
         self.cfg = scope.cfg
         self.max_states = max_states
+        self.track_obs = track_obs
+        self.final_addrs = tuple(final_addrs)
         mp = message_phase if message_phase is not None \
             else handlers.message_phase
         cfg = self.cfg
@@ -613,8 +634,64 @@ class ModelChecker:
             cur_op=_t1(res.cur_op[k]),
             cur_addr=_t1(res.cur_addr[k]),
             cur_val=_t1(res.cur_val[k]),
-            queues=tuple(queues))
+            queues=tuple(queues),
+            obs=a.obs)
+        if self.track_obs:
+            # read-retire boundary? Same rule as the engine's obs_retire
+            # ledger plane: a READ retires either at its fetch step (hit
+            # — fetch without opening a wait) or at the step that clears
+            # its wait (fill / early unblock, quirk 2 included).
+            retired_addr = None
+            if kind == "instr":
+                op, addr, _ = self.scope.programs[actor][
+                    new.instr_idx[actor]]
+                if Op(op) == Op.READ and not new.waiting[actor]:
+                    retired_addr = addr
+            elif (a.waiting[actor] and not new.waiting[actor]
+                  and a.cur_op[actor] == int(Op.READ)):
+                retired_addr = a.cur_addr[actor]
+            if retired_addr is not None:
+                obs = list(new.obs)
+                obs[actor] = obs[actor] + (
+                    self._observe(new, actor, retired_addr),)
+                new = dataclasses.replace(new, obs=tuple(obs))
         return new, int(res.metrics.msgs_dropped[k]), overflow
+
+    def _observe(self, a: AState, node: int, addr: int) -> int:
+        """The engine's read-observation rule (ops/step.py obs_val):
+        the retiring node's own cache line for `addr`, or -1 when the
+        line is absent/INVALID at retire."""
+        cidx = codec.cache_index(self.cfg, addr)
+        if (a.cache_addr[node][cidx] == addr
+                and a.cache_state[node][cidx] != int(CacheState.INVALID)):
+            return a.cache_val[node][cidx]
+        return -1
+
+    def _final_value(self, a: AState, addr: int) -> int:
+        """Authoritative value of `addr` at quiescence: the EM owner's
+        cache line when the directory records an owner (memory may be
+        stale behind a MODIFIED line), home memory otherwise."""
+        cfg = self.cfg
+        h = codec.home_node(cfg, addr)
+        b = codec.block_index(cfg, addr)
+        if a.dir_state[h][b] == int(DirState.EM):
+            bv = a.dir_bitvec[h][b]
+            cidx = codec.cache_index(cfg, addr)
+            for n in range(cfg.num_nodes):
+                if ((bv >> n) & 1
+                        and a.cache_addr[n][cidx] == addr
+                        and a.cache_state[n][cidx]
+                        != int(CacheState.INVALID)):
+                    return a.cache_val[n][cidx]
+        return a.memory[h][b]
+
+    def _outcome(self, a: AState) -> tuple:
+        """One concrete litmus outcome: every read observation in
+        node-major program order, then final_addrs' final values."""
+        reads = tuple(v for n in range(self.cfg.num_nodes)
+                      for v in a.obs[n])
+        return reads + tuple(self._final_value(a, ad)
+                             for ad in self.final_addrs)
 
     def _initial(self) -> AState:
         st = jax.device_get(
@@ -634,7 +711,9 @@ class ModelChecker:
             waiting=tuple(bool(x) for x in np.asarray(st.waiting)),
             cur_op=_t1(st.cur_op), cur_addr=_t1(st.cur_addr),
             cur_val=_t1(st.cur_val),
-            queues=tuple(() for _ in range(self.cfg.num_nodes)))
+            queues=tuple(() for _ in range(self.cfg.num_nodes)),
+            obs=(tuple(() for _ in range(self.cfg.num_nodes))
+                 if self.track_obs else ()))
 
     def _batched(self, staged: list):
         pad = _BATCH - len(staged)
@@ -940,6 +1019,16 @@ class ModelChecker:
             "violations": violations,
             "ok": not violations,
         }
+        if self.track_obs:
+            # stored states are orbit representatives; the concrete
+            # outcome set is the orbit closure over the group (permuted
+            # states are reachable runs, their outcomes row-permute)
+            outs = set()
+            for sid in quiescent_terms:
+                for g in self._group:
+                    outs.add(self._outcome(
+                        _apply_perm(cfg, g, states[sid])))
+            report["outcomes"] = sorted(outs)
         return report
 
     def _trace_to(self, parent, states, sid):

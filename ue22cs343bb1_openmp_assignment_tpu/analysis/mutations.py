@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from ue22cs343bb1_openmp_assignment_tpu import codec
 from ue22cs343bb1_openmp_assignment_tpu.ops import handlers
 from ue22cs343bb1_openmp_assignment_tpu.state import bit_single
-from ue22cs343bb1_openmp_assignment_tpu.types import DirState, Msg
+from ue22cs343bb1_openmp_assignment_tpu.types import DirState, Msg, Op
 
 
 def _is(mv, ty):
@@ -144,6 +144,74 @@ def evict_shared_keeps_bit(cfg, state, mv):
     upd = dict(upd, dir_bv=(
         m, i, jnp.where(es_home[:, None], dirbv, v)))
     return upd, cand, inv, stats
+
+
+# ---------------------------------------------------------------------------
+# Consistency mutants: bugs that keep every per-state invariant happy —
+# the directory, the bitvecs, the line states all stay self-consistent —
+# and corrupt only the *values a program observes*. They are invisible
+# to the invariant/coherence tiers and to per-location axioms (a stale
+# reload of an old value per-location just looks like "the write came
+# last"); the referees with teeth are the litmus enumeration
+# (analysis/litmus.py — the ``mp_reload`` shape) and the fuzzer's
+# consistency oracle (analysis/axioms.py — the gated full-SC check and
+# the litmus outcome-membership check).
+# ---------------------------------------------------------------------------
+
+
+def stale_fill_from_invalid(cfg, state, mv):
+    """A read fill (REPLY_RD from the home, or the owner-forwarded
+    FLUSH) that lands on a tag-matching (invalidated) resident line
+    serves the *stale local copy* instead of the reply's data — the
+    classic forgot-to-actually-use-the-fill bug: first fills are
+    clean, but a reload after an INV resurrects the dead value.
+    Expected: `sc_cycle` (a reader that saw the flag write falls back
+    to pre-invalidation data) and a forbidden ``mp_reload`` outcome."""
+    upd, cand, inv, stats = handlers.message_phase(cfg, state, mv)
+    rows = jnp.arange(cfg.num_nodes, dtype=jnp.int32)
+    cidx = codec.cache_index(cfg, mv.addr)
+    stale = ((_is(mv, Msg.REPLY_RD) | _is(mv, Msg.FLUSH))
+             & (state.cache_addr[rows, cidx] == mv.addr)
+             & state.waiting & (state.cur_addr == mv.addr)
+             & (state.cur_op == int(Op.READ)))
+    cv_m, cv_v = upd["cache_val"]
+    upd = dict(upd, cache_val=(
+        cv_m, jnp.where(stale, state.cache_val[rows, cidx], cv_v)))
+    return upd, cand, inv, stats
+
+
+def skip_inv_fanout(cfg, state, mv):
+    """The write commits without its invalidation fan-out: REPLY_ID
+    still grants EM ownership, but the sharer-set INVs are never sent
+    (mailbox mode) / never applied (scatter mode) — a write commit
+    reordered past its pending invalidation acks. Old sharers keep
+    VALID stale copies and *hit* on them. Expected: `sc_cycle` and a
+    forbidden ``mp_upgrade`` outcome (the stale-SHARED-copy shape —
+    MESI's first-reader-EXCLUSIVE means only a shape where BOTH nodes
+    read x before the write ever takes the UPGRADE path)."""
+    upd, cand, inv, stats = handlers.message_phase(cfg, state, mv)
+    if cand.get("inv") is not None and cand["inv"][0] is not None:
+        ty, recv, ad = cand["inv"]
+        cand = dict(cand, inv=(
+            jnp.full_like(ty, int(Msg.NONE)), recv, ad))
+    if inv is not None:
+        m, a, bv = inv
+        inv = (m & False, a, bv)
+    return upd, cand, inv, stats
+
+
+#: name -> (wrapper, litmus test whose enumeration kills it, axioms
+#: check the consistency oracle must raise, kill delays, kill periods).
+#: The delay/period pins are a concrete interleaving (found by sweep,
+#: frozen here) on which the litmus seed case run under the mutant
+#: produces the forbidden outcome — so the axiomatic oracle has a
+#: deterministic witness run, not just the exhaustive enumeration.
+CONSISTENCY_MUTATIONS = {
+    "stale_fill_from_invalid": (stale_fill_from_invalid, "mp_reload",
+                                "sc_cycle", (2, 0), (0, 4)),
+    "skip_inv_fanout": (skip_inv_fanout, "mp_upgrade",
+                        "sc_cycle", (0, 0), (0, 12)),
+}
 
 
 # ---------------------------------------------------------------------------

@@ -1,9 +1,12 @@
 """`cache-sim analyze` — the static-analysis gate (host-side CLI).
 
-Runs the three verification prongs: the symmetry-reduced protocol model
+Runs the verification prongs: the symmetry-reduced protocol model
 checker over the builtin small scopes, the linters (AST trace lint
-always; jaxpr IR lint + recompilation guard behind ``--jaxpr``), and
-the coverage-guided differential fuzzer behind ``--fuzz N``. Prints a
+always; jaxpr IR lint + recompilation guard behind ``--jaxpr``), the
+coverage-guided differential fuzzer behind ``--fuzz N``, and the
+memory-consistency litmus matrix behind ``--litmus`` (exhaustive
+outcome enumeration vs the declarative allowed sets,
+analysis/litmus.py). Prints a
 human report that keeps reference-sanctioned quirks (`~`) visually
 distinct from genuine violations (`!`), optionally writes the full
 JSON report, and exits by the code table in ``--help``. This is the CI
@@ -60,12 +63,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "over the MESI/MOESI/MESIF tables, then the "
                         "table-vs-handlers conformance gate on --scopes "
                         "(default 2n2h)")
+    p.add_argument("--litmus", action="store_true",
+                   help="run the memory-consistency litmus prong: "
+                        "exhaustively enumerate each test's reachable "
+                        "outcome set (model checker in litmus mode, "
+                        "symmetry-reduced) and require EXACT equality "
+                        "with the DSL's allowed set — any forbidden "
+                        "outcome, or any allowed outcome the engine "
+                        "cannot produce, is a finding")
+    p.add_argument("--litmus-tests", default=None, metavar="T1,T2",
+                   help="comma-separated litmus test names (default: "
+                        "the full builtin suite; see analysis/litmus.py)")
+    p.add_argument("--litmus-protocols", default="mesi",
+                   metavar="P1,P2",
+                   help="protocols for the litmus sweep (default mesi; "
+                        "also moesi, mesif via the declarative tables)")
     p.add_argument("--mutation", default=None,
                    help="run the gates with this seeded bug: a handler "
                         "mutation from analysis.mutations.MUTATIONS "
-                        "(checker/fuzzer/conformance must fail) or a "
+                        "(checker/fuzzer/conformance must fail), a "
                         "table mutation from TABLE_MUTATIONS "
-                        "(verify-table must fail) — the gates' own "
+                        "(verify-table must fail), or a consistency "
+                        "mutation from CONSISTENCY_MUTATIONS (litmus "
+                        "enumeration must fail) — the gates' own "
                         "regression test")
     p.add_argument("--max-states", type=int, default=50_000,
                    help="state-count guard per scope (default 50000); "
@@ -114,11 +134,19 @@ def _resolve_mutation(name):
             "declarative table, not the handlers, so it only applies to "
             "the --table prong (run with --table --skip-model-check "
             "--skip-lint)")
+    if name in mutations.CONSISTENCY_MUTATIONS:
+        raise SystemExit(
+            f"`{name}` is a consistency mutation — it keeps every "
+            "per-state invariant happy and corrupts only observed "
+            "values, so the invariant prongs cannot see it; run it "
+            "through the litmus prong (--litmus --skip-model-check "
+            "--skip-lint) or the fuzzer's consistency oracle")
     if name not in mutations.MUTATIONS:
         raise SystemExit(
             f"unknown mutation `{name}` (handler mutations: "
             f"{', '.join(mutations.MUTATIONS)}; table mutations: "
-            f"{', '.join(mutations.TABLE_MUTATIONS)})")
+            f"{', '.join(mutations.TABLE_MUTATIONS)}; consistency "
+            f"mutations: {', '.join(mutations.CONSISTENCY_MUTATIONS)})")
     return mutations.MUTATIONS[name]
 
 
@@ -176,6 +204,61 @@ def run_model_check(scope_names, mutation, max_states, quiet) -> dict:
             for line in v.get("state_render", []):
                 _print(quiet, f"      | {line}")
     return out
+
+
+def run_litmus(test_names, protocol_names, mutation, max_states,
+               quiet) -> dict:
+    """The memory-consistency prong: enumerate every (protocol, test)
+    cell of the litmus matrix and require the reachable outcome set to
+    EXACTLY equal the DSL's allowed set."""
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import (litmus,
+                                                             mutations)
+    names = (None if test_names is None else
+             [s.strip() for s in test_names.split(",") if s.strip()])
+    protos = [s.strip() for s in protocol_names.split(",") if s.strip()]
+    unknown = [n for n in (names or []) if n not in litmus.BUILTIN]
+    if unknown:
+        raise SystemExit(f"unknown litmus test(s): {', '.join(unknown)} "
+                         f"(have: {', '.join(litmus.BUILTIN)})")
+
+    mp = None
+    cmut = mutations.CONSISTENCY_MUTATIONS.get(mutation) \
+        if mutation else None
+    if mutation is not None:
+        if cmut is not None:
+            mp = cmut[0]
+            if names is None:
+                names = [cmut[1]]   # the shape documented to kill it
+            _print(quiet, f"== seeded consistency mutation `{mutation}` "
+                          f"on litmus {cmut[1]} (a forbidden outcome "
+                          "must appear)")
+        elif mutation in mutations.MUTATIONS:
+            mp = mutations.MUTATIONS[mutation][0]
+        # other kinds already rejected by _resolve_mutation upstream
+
+    def progress(proto, name, rep):
+        if rep.get("budget_exhausted"):
+            _print(quiet, f"== litmus {name} [{proto}]: BUDGET "
+                          f"EXHAUSTED ({rep['detail']}) — no finding; "
+                          "not a pass")
+            return
+        st = rep["stats"]
+        verdict = "ok" if rep["ok"] else "FAIL"
+        _print(quiet,
+               f"== litmus {name} [{proto}]: {verdict}  "
+               f"[{st['states']} states, {len(rep['observed'])} "
+               f"outcomes, allowed {len(rep['allowed'])}]")
+        for o in rep["unexpected"]:
+            _print(quiet, f"  ! forbidden outcome observed: {tuple(o)}")
+        for o in rep["unobserved"]:
+            _print(quiet, f"  ! allowed outcome never reached: "
+                          f"{tuple(o)}")
+        for v in rep["violations"]:
+            _print(quiet, f"  ! model-check violation: {v}")
+
+    return litmus.run_suite(tests=names, protocols=protos,
+                            message_phase=mp, max_states=max_states,
+                            progress=progress)
 
 
 def run_lint(paths, quiet) -> dict:
@@ -342,7 +425,7 @@ def main(argv=None) -> int:
         return 0
 
     report = {"model_check": {}, "lint": None, "jaxpr": None,
-              "fuzz": None, "table": None}
+              "fuzz": None, "table": None, "litmus": None}
     ok, exhausted = True, False
     if not args.skip_model_check:
         report["model_check"] = run_model_check(
@@ -352,6 +435,16 @@ def main(argv=None) -> int:
                 exhausted = True
             else:
                 ok &= r["ok"]
+    if args.litmus:
+        report["litmus"] = run_litmus(
+            args.litmus_tests, args.litmus_protocols, args.mutation,
+            args.max_states, args.quiet)
+        for per_proto in report["litmus"].values():
+            for r in per_proto.values():
+                if r.get("budget_exhausted"):
+                    exhausted = True
+                else:
+                    ok &= r["ok"]
     if args.table:
         report["table"] = run_table(args.scopes, args.mutation,
                                     args.max_states, args.quiet)

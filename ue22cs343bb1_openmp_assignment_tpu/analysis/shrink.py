@@ -25,7 +25,7 @@ from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
-from ue22cs343bb1_openmp_assignment_tpu.analysis import fuzz
+from ue22cs343bb1_openmp_assignment_tpu.analysis import fixtures, fuzz
 from ue22cs343bb1_openmp_assignment_tpu.obs import perfetto
 from ue22cs343bb1_openmp_assignment_tpu.ops import step
 from ue22cs343bb1_openmp_assignment_tpu.state import init_state
@@ -112,29 +112,21 @@ def shrink_case(case: fuzz.FuzzCase,
 
 # -- repro emission --------------------------------------------------------
 
-
-def _trace_lines(tr) -> str:
-    out = []
-    for op, a, v in tr:
-        out.append(f"RD 0x{a:02X}" if op == 0 else f"WR 0x{a:02X} {v}")
-    # no trailing blank line for an idle node: parse_trace loads any
-    # non-RD/WR line (even empty) as an explicit NOP instruction
-    return "\n".join(out) + ("\n" if out else "")
+# kept as an alias: obs/flight.py and older callers import the private
+# name; the canonical renderer lives in analysis/fixtures.py now
+_trace_lines = fixtures.trace_lines
 
 
 def emit_repro(shrunk: dict, out_dir: str,
                message_phase: Optional[Callable] = None) -> dict:
-    """Write the shrunk case as a fixture directory: per-node
-    ``core_<n>.txt`` (reference trace format), ``repro.json``, and a
-    validated ``trace.perfetto.json`` of the diverging run. Returns the
-    repro metadata dict."""
+    """Write the shrunk case as a fixture directory
+    (:func:`..analysis.fixtures.write_fixture`: per-node
+    ``core_<n>.txt`` in the reference trace format + ``repro.json``)
+    plus a validated ``trace.perfetto.json`` of the diverging run.
+    Returns the repro metadata dict."""
     case = shrunk["case"]
     cfg = case.config()
     os.makedirs(out_dir, exist_ok=True)
-    for n, tr in enumerate(case.traces):
-        with open(os.path.join(out_dir, f"core_{n}.txt"), "w") as f:
-            f.write(_trace_lines(tr))
-
     st = init_state(cfg, case.trace_lists(),
                     issue_delay=np.array(case.delays, np.int32),
                     issue_period=np.array(case.periods, np.int32),
@@ -147,16 +139,9 @@ def emit_repro(shrunk: dict, out_dir: str,
     perfetto.write_trace(os.path.join(out_dir, "trace.perfetto.json"),
                          doc)
 
-    meta = {"schema": "cache-sim/repro/v1",
-            "verdict": shrunk["verdict"], "detail": shrunk["detail"],
-            "instrs": shrunk["instrs_after"],
-            "num_nodes": case.num_nodes,
-            "case": case.to_dict(),
-            "files": sorted(os.listdir(out_dir)) + ["repro.json"]}
-    with open(os.path.join(out_dir, "repro.json"), "w") as f:
-        json.dump(meta, f, indent=1, sort_keys=True)
-        f.write("\n")
-    return meta
+    return fixtures.write_fixture(
+        out_dir, case, shrunk["verdict"], shrunk["detail"],
+        extra_files=["trace.perfetto.json"])
 
 
 def shrink_findings(report: dict, out_root: Optional[str] = None,

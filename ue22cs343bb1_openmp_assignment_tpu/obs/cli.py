@@ -976,7 +976,8 @@ def build_dashboard_parser() -> argparse.ArgumentParser:
                     "headline instrs/sec trend vs the 1e8 target, "
                     "bench-diff verdict strip, protocol x workload "
                     "coverage cells, the multichip sharded scaling "
-                    "curve, and the roofline scatter of recorded cost "
+                    "curve, the litmus consistency matrix (--litmus), "
+                    "and the roofline scatter of recorded cost "
                     "vectors. Deterministic: same history bytes, same "
                     "report bytes.")
     p.add_argument("captures", nargs="*",
@@ -993,6 +994,10 @@ def build_dashboard_parser() -> argparse.ArgumentParser:
                    help="write the markdown report here")
     p.add_argument("--json", action="store_true",
                    help="print the dashboard model JSON to stdout")
+    p.add_argument("--litmus", metavar="PATH",
+                   help="analyze --litmus --json report (or the bare "
+                        "litmus.run_suite dict); renders as the "
+                        "protocol x consistency-test matrix")
     return p
 
 
@@ -1022,16 +1027,25 @@ def cmd_dashboard(args) -> int:
               file=sys.stderr)
         return 2
     entries = []
+    litmus = None
     try:
         if args.history:
             entries.extend(history.load(args.history))
         for path in args.captures:
             entries.append(_ingest_any(path))
+        if args.litmus:
+            with open(args.litmus) as f:
+                doc = json.load(f)
+            # accept either the full analyze report or the bare matrix
+            litmus = doc.get("litmus", doc) if isinstance(doc, dict) \
+                else None
+            if not isinstance(litmus, dict):
+                raise ValueError(f"{args.litmus}: not a litmus report")
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     res = dashboard.render(entries, html_path=args.html,
-                           md_path=args.md)
+                           md_path=args.md, litmus=litmus)
     if args.json:
         print(json.dumps(res["model"], sort_keys=True))
     for path in (args.html, args.md):

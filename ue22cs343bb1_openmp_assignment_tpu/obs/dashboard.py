@@ -51,8 +51,27 @@ def _workload_from_metric(metric: str) -> Optional[str]:
 
 
 # lint: host
+def _litmus_cells(litmus: Optional[dict]) -> list:
+    """Normalize an ``analyze --litmus`` report ({protocol: {test:
+    enumeration report}}, analysis/litmus.run_suite) into flat matrix
+    cells sorted (test, protocol)."""
+    cells = []
+    for proto, tests in (litmus or {}).items():
+        for name, rep in tests.items():
+            cells.append({
+                "protocol": proto, "test": name,
+                "ok": rep.get("ok"),
+                "budget_exhausted": bool(rep.get("budget_exhausted")),
+                "observed": len(rep.get("observed", ())),
+                "allowed": len(rep.get("allowed", ())),
+                "unexpected": len(rep.get("unexpected", ()))})
+    return sorted(cells, key=lambda c: (c["test"], c["protocol"]))
+
+
+# lint: host
 def build_model(entries: List[dict],
-                target: float = TARGET_INSTRS_PER_S) -> dict:
+                target: float = TARGET_INSTRS_PER_S,
+                litmus: Optional[dict] = None) -> dict:
     """Reduce a loaded history to the renderable model.
 
     Splits entries into the instrs/sec headline series, the multichip
@@ -60,6 +79,8 @@ def build_model(entries: List[dict],
     pairs, (protocol x workload) coverage cells (latest entry wins a
     cell; protocol defaults to "mesi" until ROADMAP item 4 records
     one), and the roofline points of every recorded cost vector.
+    ``litmus`` is an optional ``analyze --litmus`` suite report; it
+    becomes the protocol x test consistency matrix.
     """
     bench = [e for e in entries if e.get("unit") == "instrs/sec"]
     multichip = [e for e in entries
@@ -124,6 +145,7 @@ def build_model(entries: List[dict],
                       for (p, w), v in sorted(cells.items())},
             "roofline": points, "scaling": scaling,
             "serving": serving, "latency": latency,
+            "litmus": _litmus_cells(litmus),
             "n_entries": len(entries)}
 
 
@@ -271,6 +293,42 @@ def _svg_roofline(points: List[dict]) -> str:
 
 
 # lint: host
+def _litmus_cell_text(c: dict) -> str:
+    """pass/fail/outcome-count rendering shared by both artifacts."""
+    if c["budget_exhausted"]:
+        return "budget"
+    tag = "ok" if c["ok"] else "FAIL"
+    return f"{tag} ({c['observed']}/{c['allowed']})"
+
+
+# lint: host
+def _litmus_html(cells: list) -> str:
+    if not cells:
+        return ("<p><em>no litmus report loaded (cache-sim analyze "
+                "--litmus --json, then dashboard --litmus "
+                "report.json)</em></p>")
+    protos = sorted({c["protocol"] for c in cells})
+    tests = sorted({c["test"] for c in cells})
+    by = {(c["test"], c["protocol"]): c for c in cells}
+    head = "".join(f"<th>{p}</th>" for p in protos)
+    rows = []
+    for t in tests:
+        tds = []
+        for p in protos:
+            c = by.get((t, p))
+            if c is None:
+                tds.append("<td>—</td>")
+                continue
+            color = ("#b7950b" if c["budget_exhausted"]
+                     else "#1e8449" if c["ok"] else "#c0392b")
+            tds.append(f'<td style="color:{color}">'
+                       f'{_litmus_cell_text(c)}</td>')
+        rows.append(f"<tr><td>{t}</td>{''.join(tds)}</tr>")
+    return (f"<table><tr><th>test</th>{head}</tr>"
+            + "".join(rows) + "</table>")
+
+
+# lint: host
 def render_html(model: dict) -> str:
     """The self-contained static HTML report."""
     rows = []
@@ -317,6 +375,8 @@ td, th {{ border: 1px solid #d5dbdb; padding: 4px 10px;
 {cell_rows}</table>
 <h2>Multichip sharded parity (scaling dryruns)</h2>
 {_svg_series("scaling", model["scaling"], "nodes", None, "nodes")}
+<h2>Litmus matrix: protocol &times; consistency test</h2>
+{_litmus_html(model["litmus"])}
 <h2>Roofline (recorded cost vectors)</h2>
 {_svg_roofline(model["roofline"])}
 </body></html>
@@ -395,6 +455,18 @@ def render_markdown(model: dict) -> str:
                          f"| {'yes' if s['ok'] else 'no'} |")
     else:
         lines.append("*no multichip dryruns ingested*")
+    lines += ["", "## Litmus matrix (protocol × consistency test)", ""]
+    if model["litmus"]:
+        lines += ["| test | protocol | outcome sets | verdict |",
+                  "|---|---|---:|---|"]
+        for c in model["litmus"]:
+            lines.append(f"| {c['test']} | {c['protocol']} "
+                         f"| {c['observed']}/{c['allowed']} "
+                         f"| {_litmus_cell_text(c)} |")
+    else:
+        lines.append("*no litmus report loaded (cache-sim analyze "
+                     "--litmus --json, then dashboard --litmus "
+                     "report.json)*")
     lines += ["", "## Roofline points", ""]
     if model["roofline"]:
         lines += ["| entry | kernel | AI (flop/B) | attainable flop/s "
@@ -412,10 +484,11 @@ def render_markdown(model: dict) -> str:
 
 # lint: host
 def render(entries: List[dict], html_path: Optional[str] = None,
-           md_path: Optional[str] = None) -> dict:
+           md_path: Optional[str] = None,
+           litmus: Optional[dict] = None) -> dict:
     """Build the model and write the requested artifacts; returns
     ``{"model", "html_path", "md_path"}``."""
-    model = build_model(entries)
+    model = build_model(entries, litmus=litmus)
     if html_path:
         with open(html_path, "w") as f:
             f.write(render_html(model))

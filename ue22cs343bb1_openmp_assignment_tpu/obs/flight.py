@@ -196,30 +196,17 @@ class FlightRecorder:
     # lint: host
     def _emit_case_repro(self, out_dir: str, reason: str, detail: str,
                          case: dict) -> list:
-        # exact analysis/shrink emit_repro format (core_<n>.txt in the
-        # reference trace syntax + cache-sim/repro/v1 metadata), so an
-        # incident replays through the same path as a shrunk finding
-        from ue22cs343bb1_openmp_assignment_tpu.analysis import (fuzz,
-                                                                 shrink)
+        # exact analysis/fixtures format (core_<n>.txt in the reference
+        # trace syntax + cache-sim/repro/v1 metadata), so an incident
+        # replays through the same path as a shrunk finding
+        from ue22cs343bb1_openmp_assignment_tpu.analysis import (fixtures,
+                                                                 fuzz)
         fc = fuzz.case_from_dict(case)
-        written = []
-        for n, tr in enumerate(fc.traces):
-            name = f"core_{n}.txt"
-            with open(os.path.join(out_dir, name), "w") as f:
-                f.write(shrink._trace_lines(tr))
-            written.append(name)
-        meta = {"schema": "cache-sim/repro/v1",
-                "verdict": reason.split(":", 1)[-1],
-                "detail": detail,
-                "instrs": sum(len(tr) for tr in fc.traces),
-                "num_nodes": fc.num_nodes,
-                "case": fc.to_dict(),
-                "files": sorted(written + ["trace.perfetto.json",
-                                           "repro.json"])}
-        with open(os.path.join(out_dir, "repro.json"), "w") as f:
-            json.dump(meta, f, indent=1, sort_keys=True)
-            f.write("\n")
-        return written + ["repro.json"]
+        fixtures.write_fixture(out_dir, fc, reason.split(":", 1)[-1],
+                               detail,
+                               extra_files=["trace.perfetto.json"])
+        return [f"core_{n}.txt" for n in range(fc.num_nodes)] \
+            + ["repro.json"]
 
 
 # lint: host

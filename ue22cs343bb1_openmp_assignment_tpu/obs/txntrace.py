@@ -62,7 +62,8 @@ TXN_TYPES = {int(Msg.READ_REQUEST): "read_miss",
 # lint: host
 def capture(cfg, state0, num_cycles: int, chunk: int = 64,
             message_phase: Optional[Callable] = None,
-            stop_on_quiescence: bool = True):
+            stop_on_quiescence: bool = True,
+            with_obs: bool = False):
     """Run the async engine ``num_cycles`` cycles with the message
     ledger on, in host-side ``chunk``-cycle scans (one fused dispatch
     each — the flight-recorder discipline; chunk stays a single static
@@ -85,7 +86,7 @@ def capture(cfg, state0, num_cycles: int, chunk: int = 64,
         left = num_cycles - done
         n = chunk if left >= chunk else left
         state, led = step.run_cycles_ledger(cfg, state, n,
-                                            message_phase)
+                                            message_phase, with_obs)
         parts.append({k: np.asarray(v) for k, v in led.items()})
         done += n
     if not parts:

@@ -48,16 +48,22 @@ TELEMETRY_COUNTERS = ("instrs_retired", "read_hits", "write_hits",
 #: True)): per-node dequeue record, per-(node, slot) enqueue record
 #: with the post-arbitration accept mask, the frontend issue/latch
 #: record and the wait-clear mask — everything obs/txntrace.py needs
-#: to reconstruct causal transaction spans host-side
+#: to reconstruct causal transaction spans host-side. With
+#: ``with_obs=True`` the sample additionally carries the
+#: retire-observation record (obs_retire / obs_val: what value the
+#: retiring node's own cache holds for the in-flight address at each
+#: retire boundary — the raw input of the axiomatic consistency
+#: checker, analysis/axioms.py)
 LEDGER_FIELDS = ("deq_has", "deq_sender", "deq_type", "deq_addr",
                  "enq_accept", "enq_type", "enq_recv", "enq_addr",
                  "fetch", "issue", "op", "addr", "value", "unblocked")
+LEDGER_OBS_FIELDS = ("obs_retire", "obs_val")
 
 
 def cycle(cfg: SystemConfig, state: SimState,
           with_events: bool = False, message_phase=None,
           with_telemetry: bool = False, with_ledger: bool = False,
-          deliver_fn=None):
+          with_obs: bool = False, deliver_fn=None):
     """Advance the whole machine by one cycle.
 
     Cross-sender arbitration order for this cycle's deliveries comes from
@@ -391,6 +397,25 @@ def cycle(cfg: SystemConfig, state: SimState,
             # wait cleared this cycle (span end)
             "unblocked": m_stats["unblocked"],
         }
+        if with_obs:
+            # retire observation: an instruction retires either at its
+            # fetch cycle (hit: fetch without a wait) or at its unblock
+            # cycle (miss/upgrade fill) — the two are exclusive per node
+            # per cycle (drain-before-fetch). obs_val is what the node's
+            # own cache holds for the in-flight address at that boundary
+            # (post-update arrays, the value the reference's printf dump
+            # would show); -1 = line absent/INVALID at retire. Only the
+            # axiomatic consistency checker reads these, so only its
+            # captures pay for the extra gathers.
+            ledger["obs_retire"] = ((fetch & ~f_upd["wait_set"])
+                                    | m_stats["unblocked"])
+            ledger["obs_val"] = jnp.where(
+                (cache_addr[rows, codec.cache_index(cfg, cur_addr)]
+                 == cur_addr)
+                & (cache_state[rows, codec.cache_index(cfg, cur_addr)]
+                   != int(CacheState.INVALID)),
+                cache_val[rows, codec.cache_index(cfg, cur_addr)],
+                -1).astype(jnp.int16)
         out = out + (ledger,)
     return out
 
@@ -487,22 +512,25 @@ def run_cycles_telemetry(cfg: SystemConfig, state: SimState,
     return final.replace(**ro), telem
 
 
-@functools.partial(jax.jit, static_argnums=(0, 2, 3))
+@functools.partial(jax.jit, static_argnums=(0, 2, 3, 4))
 def run_cycles_ledger(cfg: SystemConfig, state: SimState,
-                      num_cycles: int, message_phase=None):
+                      num_cycles: int, message_phase=None,
+                      with_obs: bool = False):
     """Scan `num_cycles` cycles collecting ONLY the message ledger.
 
     Same capture as ``run_cycles_telemetry(..., with_ledger=True)``
     minus the telemetry planes (counter deltas, occupancy scans) — the
     ledger samples are bit-identical either way, this path just skips
     work the caller will not read. obs/txntrace.capture runs on this;
-    returns ``(state, ledger)``.
+    returns ``(state, ledger)``. ``with_obs=True`` (static) adds the
+    LEDGER_OBS_FIELDS retire-observation planes for the axiomatic
+    consistency checker.
     """
     carry0, ro, blanks = _ro_outside(state)
 
     def body(s, _):
         out, led = cycle(cfg, s.replace(**ro), with_ledger=True,
-                         message_phase=message_phase)
+                         with_obs=with_obs, message_phase=message_phase)
         return out.replace(**blanks), led
 
     final, ledger = jax.lax.scan(body, carry0, None, length=num_cycles)
