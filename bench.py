@@ -161,6 +161,31 @@ def _soak_bench(args, jax):
         args.arrival_rate, args.soak_duration, nodes=args.nodes,
         trace_len=args.trace_len, seed=0)
 
+    daemon = None
+    if args.daemon:
+        # --daemon: the measured path is the real serving front door —
+        # socket transport + continuous admission — not in-process
+        # waves. Same metric string, so bench-diff adjudicates the
+        # transport change on the v1.4 latency samples.
+        import tempfile
+        import threading
+        from ue22cs343bb1_openmp_assignment_tpu.daemon.client import (
+            DaemonClient)
+        from ue22cs343bb1_openmp_assignment_tpu.daemon.core import (
+            DaemonCore)
+        from ue22cs343bb1_openmp_assignment_tpu.daemon.server import (
+            DaemonServer)
+        sock = os.path.join(
+            tempfile.mkdtemp(prefix="cache-sim-bench-"), "daemon.sock")
+        server = DaemonServer(
+            DaemonCore(slots=args.serve_slots, chunk=args.chunk,
+                       max_cycles=max_cycles, queue_capacity=qcap),
+            sock, quiet=True)
+        thread = threading.Thread(target=server.run, daemon=True,
+                                  name="bench-daemon")
+        thread.start()
+        daemon = (sock, server, thread, DaemonClient)
+
     def run(clock=None):
         return soak_mod.soak(arrivals, slots=args.serve_slots,
                              chunk=args.chunk, max_cycles=max_cycles,
@@ -170,14 +195,40 @@ def _soak_bench(args, jax):
 
     from ue22cs343bb1_openmp_assignment_tpu.obs.phases import PhaseTimer
     timer = PhaseTimer()
-    with timer.phase("warmup_compile"):
-        # same wave jit signature on a virtual clock: compiles the
-        # wave for this slot shape without wall-clock latency samples
-        run(VirtualClock())
+    try:
+        with timer.phase("warmup_compile"):
+            if daemon:
+                # one throwaway job of the stream shape compiles the
+                # daemon's bucket chunk before latencies are sampled
+                import dataclasses
+                sock, _, _, DaemonClient = daemon
+                with DaemonClient(sock) as c:
+                    c.wait_up()
+                    c.submit(dataclasses.replace(arrivals[0][1],
+                                                 name="warmup000"))
+                    c.wait("warmup000", timeout_s=120.0)
+            else:
+                # same wave jit signature on a virtual clock: compiles
+                # the wave for this slot shape without wall-clock
+                # latency samples
+                run(VirtualClock())
 
-    t0 = time.perf_counter()
-    doc = run()                        # MonotonicClock: real latencies
-    timer.add("soak_pass", time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        if daemon:                     # client clock: real latencies
+            doc = soak_mod.soak_daemon(arrivals, daemon[0],
+                                       arrival_rate=args.arrival_rate)
+        else:
+            doc = run()                # MonotonicClock: real latencies
+        timer.add("soak_pass", time.perf_counter() - t0)
+    finally:
+        if daemon:
+            sock, server, thread, DaemonClient = daemon
+            try:
+                with DaemonClient(sock) as c:
+                    c.shutdown()
+            except (ConnectionError, OSError):
+                server.stop()
+            thread.join(10.0)
 
     lat = doc["latency"]
     if lat is None:
@@ -221,6 +272,7 @@ def _soak_bench(args, jax):
             "slots": args.serve_slots,
             "arrival_rate": args.arrival_rate,
             "duration_s": args.soak_duration,
+            "transport": "daemon" if args.daemon else "inproc",
             "platform": platform, "smoke": bool(args.smoke),
         }
         latency_block = {
@@ -229,8 +281,9 @@ def _soak_bench(args, jax):
             "jobs": lat["jobs"],
             "arrival_rate": float(args.arrival_rate),
             "queue_depth_peak": doc["series_summary"]["queue_depth_peak"],
-            "samples_ms": [round(s["e2e_s"] * 1e3, 6)
-                           for s in doc["trace"]["spans"]],
+            "samples_ms": (doc.get("samples_ms")
+                           or [round(s["e2e_s"] * 1e3, 6)
+                               for s in doc["trace"]["spans"]]),
             "duration_s": float(args.soak_duration),
             "saturated": doc["verdict"]["saturated"],
             "drain_rate_jobs_per_s": doc["drain_rate_jobs_per_s"],
@@ -240,6 +293,7 @@ def _soak_bench(args, jax):
             "waves": doc["wave_count"], "devices": 1,
             "mb_dropped": doc["mb_dropped"],
             "padding_waste": round(doc["padding_waste"], 4),
+            "transport": "daemon" if args.daemon else "inproc",
         }
         hist_doc = history.entry(
             label=f"soak@{args.arrival_rate:g}/s",
@@ -405,6 +459,14 @@ def main():
     ap.add_argument("--soak-duration", type=float, default=2.0,
                     help="--soak: arrival window in seconds "
                          "(default 2); the run drains fully after")
+    ap.add_argument("--daemon", action="store_true",
+                    help="--soak: route the stream through an "
+                         "in-process serving daemon on a temp unix "
+                         "socket (daemon/: socket transport, "
+                         "continuous admission, shape bucketing in "
+                         "the measured path); same metric string so "
+                         "bench-diff --latency adjudicates daemon vs "
+                         "in-process")
     ap.add_argument("--devices", type=int, default=1,
                     help="--serve: shard the wave's batch axis over "
                          "this many local devices (serve.py batch "
@@ -469,6 +531,9 @@ def main():
     if args.serve and args.soak:
         print("error: --serve and --soak are exclusive (closed-loop "
               "jobs/sec vs open-loop latency)", file=sys.stderr)
+        return 2
+    if args.daemon and not args.soak:
+        print("error: --daemon is a --soak transport", file=sys.stderr)
         return 2
     if args.serve:
         return _serve_bench(args, jax)

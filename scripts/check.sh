@@ -251,6 +251,68 @@ print(f"soak smoke: ok ({doc['jobs_total']} jobs quiesced, "
 PY
 rm -rf "$SOAK_DIR"
 
+# Daemon smoke (each step 30s-boxed): the persistent serving front
+# door end to end. Start `cache-sim daemon` on a temp unix socket,
+# submit mixed-lane jobs through `cache-sim submit --wait`, run an
+# easy-SLO soak THROUGH THE SOCKET (exit 0), force a sub-ms p95
+# breach (must exit 4 and dump a loadable incident dir), then drain +
+# shutdown — the daemon process must exit cleanly (no orphan) and
+# unlink its socket.
+DAEMON_DIR="$(mktemp -d)"
+DSOCK="$DAEMON_DIR/daemon.sock"
+python -m ue22cs343bb1_openmp_assignment_tpu.cli daemon \
+    --addr "$DSOCK" --slots 2 --chunk 8 --quiet &
+DPID=$!
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.cli \
+    submit --addr "$DSOCK" --wait-up 25 --wait --timeout 25 \
+    --job '{"name":"smoke0","workload":"uniform","nodes":2,"trace_len":4,"lane":"interactive"}' \
+    --job '{"name":"smoke1","workload":"hotspot","nodes":4,"trace_len":8,"lane":"batch"}'
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.cli soak \
+    --daemon "$DSOCK" --arrival-rate 40 --duration 0.2 --nodes 2 \
+    --trace-len 4 --seed 0 --slo p95=100000
+rc=0
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.cli soak \
+    --daemon "$DSOCK" --arrival-rate 40 --duration 0.2 --nodes 2 \
+    --trace-len 4 --seed 1 --slo p95=0.001 \
+    --incident-dir "$DAEMON_DIR/incident" || rc=$?
+if [[ "$rc" != 4 ]]; then
+    echo "daemon soak SLO self-test FAILED: sub-ms p95 bound exited" \
+         "$rc, want 4" >&2
+    exit 1
+fi
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.cli \
+    submit --addr "$DSOCK" --stats --drain --shutdown > "$DAEMON_DIR/stats.json"
+for _ in $(seq 1 60); do                   # ≤30 s for a clean exit
+    kill -0 "$DPID" 2>/dev/null || break
+    sleep 0.5
+done
+if kill -0 "$DPID" 2>/dev/null; then
+    echo "daemon smoke FAILED: daemon still running after shutdown" \
+         "(orphan pid $DPID)" >&2
+    kill -9 "$DPID"
+    exit 1
+fi
+wait "$DPID" || true
+if [[ -e "$DSOCK" ]]; then
+    echo "daemon smoke FAILED: socket not unlinked on shutdown" >&2
+    exit 1
+fi
+python - "$DAEMON_DIR" <<'PY'
+import json, pathlib, sys
+from ue22cs343bb1_openmp_assignment_tpu import soak
+d = pathlib.Path(sys.argv[1])
+st = json.loads((d / "stats.json").read_text())
+assert st["jobs"]["done"] == st["jobs"]["quiesced"] > 2, st["jobs"]
+assert st["mb_dropped"] == 0, st
+assert set(st["lanes"]) == {"interactive", "batch"}
+inc = soak.load_incident(d / "incident")
+assert inc["breaches"][0]["metric"] == "p95_ms"
+print(f"daemon smoke: ok ({st['jobs']['done']} jobs over the socket "
+      f"across {len(st['buckets'])} bucket(s), SLO breach exit 4, "
+      f"drain + clean shutdown, socket unlinked)")
+PY
+rm -rf "$DAEMON_DIR"
+
 # RDMA-transport smoke (30s box): on 8 virtual CPU devices the Pallas
 # remote-DMA ring router (interpret mode — the CPU CI correctness
 # contract, parallel/rdma_comm) must bucket and exchange lanes

@@ -49,10 +49,28 @@ requires_reference = pytest.mark.skipif(
 # while the 870 s budget holds. The full suite (no -m filter) still runs
 # everything.
 _SLOW_PARAM_IDS = {
+    # the home_chain scenarios' deep enumeration is memoized per
+    # process, so whichever param runs first pays the whole ~30-40 s
+    # warm-up: marking a subset just moves the cost to a sibling.
+    # All four params of each scenario live here (the same treatment
+    # the outcome-inclusion home_chain params got); evict_race /
+    # migrate3 / storm_* keep the native-enumeration gate in tier-1.
     "tests/test_native_enumeration.py::"
     "test_deep_outcomes_within_native_enumeration[storm_home_chain-1-False]",
     "tests/test_native_enumeration.py::"
+    "test_deep_outcomes_within_native_enumeration[storm_home_chain-3-False]",
+    "tests/test_native_enumeration.py::"
+    "test_deep_outcomes_within_native_enumeration[storm_home_chain-1-True]",
+    "tests/test_native_enumeration.py::"
+    "test_deep_outcomes_within_native_enumeration[storm_home_chain-2-True]",
+    "tests/test_native_enumeration.py::"
     "test_deep_outcomes_within_native_enumeration[wave_home_chain-1-False]",
+    "tests/test_native_enumeration.py::"
+    "test_deep_outcomes_within_native_enumeration[wave_home_chain-3-False]",
+    "tests/test_native_enumeration.py::"
+    "test_deep_outcomes_within_native_enumeration[wave_home_chain-1-True]",
+    "tests/test_native_enumeration.py::"
+    "test_deep_outcomes_within_native_enumeration[wave_home_chain-2-True]",
     "tests/test_outcome_inclusion.py::"
     "test_multi_txn_window_outcomes_are_reachable[migrate3]",
     "tests/test_outcome_inclusion.py::"

@@ -131,11 +131,40 @@ def test_padded_job_metrics_match_solo():
 
 
 def test_wave_recompile_guard():
-    """Two heterogeneous waves at one slot shape compile once."""
+    """Two heterogeneous waves at one slot shape compile once; the
+    daemon's bucketed admission loop compiles at most one chunk runner
+    per bucket and a replay adds nothing."""
     from ue22cs343bb1_openmp_assignment_tpu.analysis import lint_jaxpr
     rep = lint_jaxpr.recompile_guard()
     assert rep["wave_cache_size"] == 1
+    assert rep["daemon_buckets"] == 2      # two non-nesting shapes
+    assert rep["daemon_wave_compiles"] <= rep["daemon_buckets"]
     assert rep["ok"]
+
+
+def test_weighted_padding_waste_two_wave_regression():
+    """Pin the budget-weighted aggregate: with per-wave instr budgets
+    differing (what shape bucketing produces), the summary must weight
+    each wave by its budget — an unweighted mean of the per-wave
+    ratios is a different (wrong) number."""
+    waves = [
+        {"slot_instr_budget": 64, "real_instrs": 64},    # 0% waste
+        {"slot_instr_budget": 1024, "real_instrs": 512},  # 50% waste
+    ]
+    got = serve.weighted_padding_waste(waves)
+    assert got == pytest.approx(1.0 - 576.0 / 1088.0)    # ~0.4706
+    unweighted = np.mean([1.0 - 64 / 64, 1.0 - 512 / 1024])
+    assert abs(got - unweighted) > 0.2    # the distinction is real
+    assert serve.weighted_padding_waste([]) == 0.0
+
+
+def test_serve_summary_padding_waste_is_budget_weighted():
+    """End to end: serve()'s summary padding_waste equals the
+    budget-weighted recomputation from its own per-wave docs."""
+    specs = serve.mixed_jobs(5, nodes=4, trace_len=8)
+    doc = serve.serve(specs, slots=2)
+    assert doc["padding_waste"] == pytest.approx(
+        serve.weighted_padding_waste(doc["waves"]))
 
 
 def test_load_jobs_jsonl_and_dir(tmp_path):

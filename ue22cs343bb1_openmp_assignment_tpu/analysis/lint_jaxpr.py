@@ -244,6 +244,45 @@ def recompile_guard(cfg: Optional[SystemConfig] = None) -> dict:
     sv = after - before
     sv_ok = sv <= 1 and after == mid
 
+    # the daemon's bucketed waves: a TWO-shape stream through the full
+    # DaemonCore admission loop (lanes, bucketing, continuous
+    # admission over run_wave_chunk) must compile at most one chunk
+    # runner PER BUCKET, and replaying the same stream on a fresh core
+    # must add nothing — the bucket classes pin the jit signatures, so
+    # mid-wave swaps and lane scheduling never touch the trace.
+    # chunk/max_cycles are chosen so no other caller warms this
+    # signature
+    from ue22cs343bb1_openmp_assignment_tpu.daemon import core as dcore
+    from ue22cs343bb1_openmp_assignment_tpu.serve import JobSpec
+
+    def _daemon_pass():
+        c = dcore.DaemonCore(slots=2, max_buckets=2, chunk=5,
+                             max_cycles=50_003,
+                             clock=VirtualClock(), keep_dumps=False)
+        # shapes chosen so neither covers the other — (n,8) vs (2n,4)
+        # — forcing two distinct buckets, i.e. two jit signatures
+        arrivals = [
+            (0.0, JobSpec(name="dg00", workload="uniform",
+                          nodes=cfg.num_nodes, trace_len=8), "batch"),
+            (0.0, JobSpec(name="dg01", workload="hotspot",
+                          nodes=2 * cfg.num_nodes, trace_len=4),
+             "interactive"),
+            (0.001, JobSpec(name="dg02", workload="uniform",
+                            nodes=cfg.num_nodes, trace_len=8),
+             "batch"),
+        ]
+        dcore.drive(c, arrivals)
+        return len(c.buckets)
+
+    chunk_fn = step.run_wave_chunk
+    d_before = chunk_fn._cache_size()
+    d_buckets = _daemon_pass()
+    d_mid = chunk_fn._cache_size()
+    _daemon_pass()                       # fresh core, same stream
+    d_after = chunk_fn._cache_size()
+    dv = d_after - d_before
+    dv_ok = dv <= d_buckets and d_after == d_mid
+
     # the native build cache is content-hash keyed: a second engine
     # must reuse the compiled library byte-for-byte (same path, no
     # rebuild — the mtime would move if the .so were recompiled)
@@ -258,6 +297,8 @@ def recompile_guard(cfg: Optional[SystemConfig] = None) -> dict:
     return {"async_cache_size": a, "sync_cache_size": s,
             "wave_cache_size": w,
             "serve_wave_compiles": sv,
+            "daemon_wave_compiles": dv,
+            "daemon_buckets": d_buckets,
             "native_build_reused": bool(n_ok),
-            "ok": (a == 1 and s == 1 and w == 1 and sv_ok
+            "ok": (a == 1 and s == 1 and w == 1 and sv_ok and dv_ok
                    and bool(n_ok))}

@@ -631,6 +631,14 @@ def main(argv=None) -> int:
     if raw[:1] == ["soak"]:
         from ue22cs343bb1_openmp_assignment_tpu import soak as soak_mod
         return soak_mod.main(raw[1:])
+    if raw[:1] == ["daemon"]:
+        from ue22cs343bb1_openmp_assignment_tpu.daemon import (
+            server as daemon_server)
+        return daemon_server.main(raw[1:])
+    if raw[:1] == ["submit"]:
+        from ue22cs343bb1_openmp_assignment_tpu.daemon import (
+            client as daemon_client)
+        return daemon_client.main(raw[1:])
     args = build_parser().parse_args(raw)
     if args.cpu:
         import jax

@@ -1,0 +1,30 @@
+"""Persistent serving daemon: the always-on front door (ROADMAP item 2).
+
+``cache-sim serve`` is a batch program — the whole job stream is
+present at entry and the process dies with its jit caches. This
+package is the production configuration the paper's "millions of
+users" framing actually implies:
+
+- :mod:`protocol` — the newline-delimited JSON socket protocol
+  (``submit`` / ``status`` / ``result`` / ``stats`` / ``trace`` /
+  ``drain`` / ``shutdown``) and unix/tcp address parsing;
+- :mod:`bucketing` — slot shape classes chosen from the queue's shape
+  histogram (bounds padding_waste, pins compile count);
+- :mod:`core` — the deterministic scheduler: continuous admission
+  (mid-wave slot swaps via ``ops.step.run_wave_chunk`` +
+  ``state.set_state``), priority lanes with weighted admission, and
+  bounded queues with explicit ``rejected`` backpressure;
+- :mod:`server` — the socket layer around the core (``cache-sim
+  daemon``);
+- :mod:`client` — the thin ``cache-sim submit`` client.
+
+The core is fully synchronous and clock-injected: under a
+VirtualClock two identical submission schedules emit byte-identical
+serve-trace docs, so every scheduler behavior is testable without a
+socket or wall clock. The server adds ONLY transport: handler threads
+enqueue into the core under one lock; the scheduler thread owns every
+JAX call.
+"""
+
+from ue22cs343bb1_openmp_assignment_tpu.daemon.core import (  # noqa: F401
+    DaemonCore, drive)

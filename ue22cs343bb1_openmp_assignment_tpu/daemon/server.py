@@ -1,0 +1,268 @@
+"""The daemon socket layer: transport around DaemonCore.
+
+Threading model (deliberately boring): the thread that calls
+:meth:`DaemonServer.run` IS the scheduler — it owns every JAX call
+(``pump``). An acceptor thread hands each connection to a handler
+thread, and handlers only translate wire lines into core method calls
+under the ONE server lock; they never touch device state. A submit
+notifies the scheduler's condition variable so an idle daemon wakes
+immediately instead of on the poll tick. ``drain`` blocks its handler
+on the same condition until the core reports idle; ``shutdown``
+responds first, then stops the scheduler after the current chunk and
+removes the socket — a clean exit the check.sh smoke verifies leaves
+no orphaned process.
+"""
+# lint: host
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Optional
+
+from ue22cs343bb1_openmp_assignment_tpu.daemon import protocol
+from ue22cs343bb1_openmp_assignment_tpu.daemon.core import DaemonCore
+from ue22cs343bb1_openmp_assignment_tpu.serve import JobSpec
+
+#: scheduler poll tick when idle (seconds); submits wake it earlier
+IDLE_TICK_S = 0.01
+
+
+class DaemonServer:
+    """Serve a DaemonCore over a unix or tcp socket."""
+
+    # lint: host
+    def __init__(self, core: DaemonCore, addr: str,
+                 quiet: bool = True):
+        self.core = core
+        self.quiet = quiet
+        self.lock = threading.RLock()
+        self.wake = threading.Condition(self.lock)
+        self._stop = threading.Event()
+        self.family, target = protocol.parse_addr(addr)
+        self._unix_path: Optional[str] = (
+            target if self.family == socket.AF_UNIX else None)
+        if self._unix_path and os.path.exists(self._unix_path):
+            os.unlink(self._unix_path)      # stale socket from a kill
+        self.sock = socket.socket(self.family, socket.SOCK_STREAM)
+        if self.family == socket.AF_INET:
+            self.sock.setsockopt(socket.SOL_SOCKET,
+                                 socket.SO_REUSEADDR, 1)
+        self.sock.bind(target)
+        self.sock.listen(16)
+        self.addr = (self._unix_path if self._unix_path else
+                     "tcp:%s:%d" % self.sock.getsockname())
+
+    # lint: host
+    def stop(self) -> None:
+        self._stop.set()
+        with self.wake:
+            self.wake.notify_all()
+
+    # lint: host
+    def run(self) -> int:
+        """The scheduler loop; returns 0 on a clean shutdown."""
+        acceptor = threading.Thread(target=self._accept_loop,
+                                    daemon=True, name="daemon-accept")
+        acceptor.start()
+        if not self.quiet:
+            print(f"daemon: listening on {self.addr}", flush=True)
+        try:
+            while not self._stop.is_set():
+                with self.wake:
+                    ran = self.core.pump() if not self.core.idle() \
+                        else False
+                    # progress may have flushed a drain or finished a
+                    # polled job — let blocked handlers re-check
+                    self.wake.notify_all()
+                    if not ran and not self._stop.is_set():
+                        self.wake.wait(IDLE_TICK_S)
+        finally:
+            self._close()
+        if not self.quiet:
+            print("daemon: shut down cleanly", flush=True)
+        return 0
+
+    # lint: host
+    def _close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        if self._unix_path and os.path.exists(self._unix_path):
+            os.unlink(self._unix_path)
+
+    # lint: host
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return                       # socket closed on stop
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="daemon-conn").start()
+
+    # lint: host
+    def _serve_conn(self, conn) -> None:
+        f = conn.makefile("rwb")
+        try:
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    req = protocol.decode(line)
+                    resp = self._handle(req)
+                except Exception as e:  # noqa: BLE001 — wire boundary
+                    resp = protocol.error(None, str(e))
+                f.write(protocol.encode(resp))
+                f.flush()
+                if self._stop.is_set():
+                    break
+        except (OSError, ValueError):
+            pass                             # client went away
+        finally:
+            try:
+                f.close()
+                conn.close()
+            except OSError:
+                pass
+
+    # lint: host
+    def _handle(self, req: dict) -> dict:
+        op = req.get("op")
+        if op not in protocol.OPS:
+            return protocol.error(op, f"unknown op {op!r} "
+                                      f"(one of {protocol.OPS})")
+        if op == "ping":
+            return {"ok": True, "op": "ping"}
+        if op == "submit":
+            try:
+                spec = JobSpec.from_dict(req.get("spec") or {})
+            except (TypeError, ValueError) as e:
+                return protocol.error("submit", f"bad job spec: {e}")
+            with self.wake:
+                resp = self.core.submit(spec,
+                                        lane=req.get("lane", "batch"))
+                self.wake.notify_all()       # wake an idle scheduler
+            return resp
+        if op == "status":
+            with self.lock:
+                return self.core.status(req.get("job", ""))
+        if op == "result":
+            with self.lock:
+                return self.core.result(req.get("job", ""))
+        if op == "stats":
+            with self.lock:
+                return {"ok": True, "op": "stats",
+                        "stats": self.core.stats()}
+        if op == "trace":
+            with self.lock:
+                return {"ok": True, "op": "trace",
+                        "trace": self.core.trace_doc()}
+        if op == "drain":
+            with self.wake:
+                self.core.drain()
+                self.wake.notify_all()
+                while not self.core.idle() and not self._stop.is_set():
+                    self.wake.wait(IDLE_TICK_S)
+                done = sum(ln.done for ln in self.core.lanes.values())
+            return {"ok": True, "op": "drain", "drained": True,
+                    "jobs_done": done}
+        # shutdown: respond, then stop after the current chunk
+        self.stop()
+        return {"ok": True, "op": "shutdown", "stopping": True}
+
+
+# lint: host
+def parse_lane_weights(spec: str) -> dict:
+    """``"interactive=4,batch=1"`` → weight dict (ints >= 1)."""
+    out = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad lane weight {part!r} "
+                             f"(want lane=N)")
+        name, w = part.split("=", 1)
+        weight = int(w)
+        if weight < 1:
+            raise ValueError(f"lane weight must be >= 1, got {w}")
+        out[name.strip()] = weight
+    if not out:
+        raise ValueError(f"empty lane-weight spec {spec!r}")
+    return out
+
+
+# lint: host
+def main(argv=None) -> int:
+    """``cache-sim daemon`` entry point."""
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="cache-sim daemon",
+        description="persistent serving daemon: accept jobs over a "
+                    "unix/tcp socket with continuous admission, "
+                    "shape bucketing, and priority lanes")
+    ap.add_argument("--addr", required=True,
+                    help="listen address: a unix socket path, or "
+                         "tcp:HOST:PORT")
+    ap.add_argument("--slots", type=int, default=4,
+                    help="batch slots per shape bucket (default 4)")
+    ap.add_argument("--max-buckets", type=int, default=4,
+                    help="slot shape classes per protocol (default 4)")
+    ap.add_argument("--chunk", type=int, default=16,
+                    help="cycles per admission chunk (default 16) — "
+                         "the continuous-admission granularity")
+    ap.add_argument("--max-cycles", type=int, default=100_000)
+    ap.add_argument("--queue-capacity", type=int, default=64)
+    ap.add_argument("--lane-depth", type=int,
+                    default=protocol.DEFAULT_LANE_DEPTH,
+                    help="per-lane admission queue bound (default "
+                         f"{protocol.DEFAULT_LANE_DEPTH}); a full "
+                         "lane rejects explicitly")
+    ap.add_argument("--lane-weights", default=None,
+                    help='admission weights, e.g. '
+                         '"interactive=4,batch=1" (the default)')
+    ap.add_argument("--retain", type=int,
+                    default=protocol.DEFAULT_RETAIN_RESULTS,
+                    metavar="N",
+                    help="keep only the newest N finished/rejected "
+                         "jobs' results, statuses, and spans in "
+                         "memory (default "
+                         f"{protocol.DEFAULT_RETAIN_RESULTS}) — "
+                         "bounds a long-lived daemon; evicted jobs "
+                         "answer 'unknown'")
+    ap.add_argument("--keep-dumps", action="store_true",
+                    help="retain per-node dumps in memory so `result` "
+                         "returns them over the socket (off by "
+                         "default for a long-lived daemon; --out-dir "
+                         "streams dumps to disk either way)")
+    ap.add_argument("--out-dir", default=None,
+                    help="also stream per-job dumps + metrics here")
+    ap.add_argument("--quiet", action="store_true")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force JAX_PLATFORMS=cpu (set before jax "
+                         "import)")
+    args = ap.parse_args(argv)
+    if args.cpu:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    weights = (parse_lane_weights(args.lane_weights)
+               if args.lane_weights else None)
+    core = DaemonCore(slots=args.slots, max_buckets=args.max_buckets,
+                      chunk=args.chunk, max_cycles=args.max_cycles,
+                      queue_capacity=args.queue_capacity,
+                      lane_depth=args.lane_depth, lane_weights=weights,
+                      out_dir=args.out_dir,
+                      keep_dumps=args.keep_dumps,
+                      retain_results=args.retain)
+    server = DaemonServer(core, args.addr, quiet=args.quiet)
+    try:
+        return server.run()
+    except KeyboardInterrupt:
+        server.stop()
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

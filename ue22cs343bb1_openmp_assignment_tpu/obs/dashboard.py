@@ -99,7 +99,9 @@ def build_model(entries: List[dict],
                 "arrival_rate": e["latency"].get("arrival_rate"),
                 "queue_depth_peak": e["latency"].get(
                     "queue_depth_peak"),
-                "saturated": e["latency"].get("saturated")}
+                "saturated": e["latency"].get("saturated"),
+                "transport": (e.get("serve") or {}).get("transport",
+                                                        "inproc")}
                for e in entries if isinstance(e.get("latency"), dict)]
     headline = [{"label": e["label"], "value": float(e["value"]),
                  "engine": (e.get("config") or {}).get("engine"),
@@ -415,9 +417,9 @@ def render_markdown(model: dict) -> str:
                      "--record)*")
     lines += ["", "## Open-loop job latency (p95 ms)", ""]
     if model["latency"]:
-        lines += ["| entry | arrival rate | p50 ms | p95 ms "
-                  "| p99 ms | queue peak | saturated |",
-                  "|---|---:|---:|---:|---:|---:|---|"]
+        lines += ["| entry | transport | arrival rate | p50 ms "
+                  "| p95 ms | p99 ms | queue peak | saturated |",
+                  "|---|---|---:|---:|---:|---:|---:|---|"]
         for l in model["latency"]:
             rate = ("?" if l["arrival_rate"] is None
                     else f"{l['arrival_rate']:g}/s")
@@ -425,7 +427,7 @@ def render_markdown(model: dict) -> str:
                   else f"{l['queue_depth_peak']}")
             sat = ("?" if l["saturated"] is None
                    else ("yes" if l["saturated"] else "no"))
-            lines.append(f"| {l['label']} | {rate} "
+            lines.append(f"| {l['label']} | {l['transport']} | {rate} "
                          f"| {l['p50_ms']:.4g} | {l['value']:.4g} "
                          f"| {l['p99_ms']:.4g} | {qp} | {sat} |")
     else:

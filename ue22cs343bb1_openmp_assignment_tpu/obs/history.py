@@ -61,6 +61,10 @@ _OPT_KEYS_V14 = _OPT_KEYS_V13 + ("latency",)
 #: same block — absent in pre-multi-device captures, no schema bump
 _SERVE_KEYS = ("slots", "jobs", "waves", "padding_waste")
 _SERVE_OPT_KEYS = ("devices", "mb_dropped")
+#: optional serve transport: "inproc" (serve/soak in-process
+#: waves) or "daemon" (the soak stream went over the daemon's
+#: socket front door); absent in pre-daemon captures
+_SERVE_TRANSPORTS = ("inproc", "daemon")
 
 #: required fields of a latency block: the nearest-rank percentiles
 #: (ms), the arrival rate the stream was released at (jobs/s — part of
@@ -210,6 +214,10 @@ def validate_entry(doc: dict) -> dict:
                                       or isinstance(x, bool) or x < 0):
                     errs.append(f"serve.{k} must be None or a "
                                 f"non-negative int, got {x!r}")
+            tr = srv.get("transport")
+            if tr is not None and tr not in _SERVE_TRANSPORTS:
+                errs.append("serve.transport must be one of "
+                            f"{_SERVE_TRANSPORTS}, got {tr!r}")
     lat = doc.get("latency")
     if lat is not None:
         if not isinstance(lat, dict):

@@ -135,6 +135,12 @@ def serve_span_events(spans: List[dict]) -> List[dict]:
         t_ext = s["t_extracted"] * _US
         args = {"wave": s["wave"], "slot": s["slot"],
                 "quiesced": s["quiesced"]}
+        # daemon spans carry the priority lane and shape-bucket label
+        # (obs.schema optional span keys) — surface them in the slice
+        # args so a Perfetto query can split latency by lane
+        for k in ("lane", "bucket"):
+            if s.get(k) is not None:
+                args[k] = s[k]
         out.append({"name": f"queued {s['job']}", "ph": "X",
                     "cat": "serve", "pid": PID_QUEUE, "tid": 0,
                     "ts": t_sub, "dur": max(t_adm - t_sub, 1.0),

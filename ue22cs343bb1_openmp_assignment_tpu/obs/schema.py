@@ -320,6 +320,13 @@ SPAN_KEYS = ("job", "wave", "slot", "quiesced",
              "t_quiescent", "t_extracted",
              "queue_wait_s", "run_s", "extract_s", "e2e_s")
 
+#: optional span fields: the daemon's tenancy annotations
+#: (daemon/core.py stamps them via SpanBook.annotate) — ``lane`` is
+#: the priority lane the job was admitted from, ``bucket`` the shape
+#: bucket label it ran in. serve/soak spans omit both; anything else
+#: unknown is still rejected.
+_SPAN_OPT_KEYS = ("lane", "bucket")
+
 #: the lifecycle timestamps in causal order (monotone per span)
 _SPAN_TS_ORDER = ("t_submit", "t_queued", "t_admitted", "t_running",
                   "t_quiescent", "t_extracted")
@@ -336,8 +343,12 @@ def _validate_span(i: int, s, errs) -> None:
         if k not in s:
             errs.append(f"span {i}: missing key {k}")
             return
-    for k in set(s) - set(SPAN_KEYS):
+    for k in set(s) - set(SPAN_KEYS) - set(_SPAN_OPT_KEYS):
         errs.append(f"span {i}: unknown key {k}")
+    for k in _SPAN_OPT_KEYS:
+        if k in s and (not isinstance(s[k], str) or not s[k]):
+            errs.append(f"span {i}: {k} must be a non-empty string, "
+                        f"got {s[k]!r}")
     if not isinstance(s["job"], str) or not s["job"]:
         errs.append(f"span {i}: job must be a non-empty string")
     for k in ("wave", "slot"):
@@ -414,4 +425,87 @@ def validate_serve_trace(doc: dict) -> dict:
                 errs.append(f"latency percentiles not monotone: {ps}")
     if errs:
         raise ValueError("invalid serve trace:\n  " + "\n  ".join(errs))
+    return doc
+
+
+# -- serving daemon: stats snapshot ----------------------------------------
+
+DAEMON_STATS_SCHEMA_ID = "cache-sim/daemon-stats/v1"
+
+#: required top-level keys of a daemon ``stats`` response
+#: (daemon/core.DaemonCore.stats) — one point-in-time snapshot of the
+#: admission queues, shape buckets, and padding accounting
+_DAEMON_TOP_KEYS = ("schema", "clock", "uptime_s", "draining", "jobs",
+                    "lanes", "buckets", "chunks", "busy_s",
+                    "drain_rate_jobs_per_s", "mb_dropped",
+                    "mid_wave_swaps", "bucket_growths",
+                    "queue_depth_peak", "retain_results",
+                    "results_evicted", "padding_waste",
+                    "single_shape_padding_waste")
+
+_DAEMON_JOB_KEYS = ("submitted", "rejected", "done", "quiesced")
+
+_DAEMON_LANE_KEYS = ("weight", "depth", "queued", "submitted",
+                     "admitted", "rejected", "done", "latency")
+
+_DAEMON_BUCKET_KEYS = ("bucket", "protocol", "nodes", "trace_len",
+                       "slots", "busy", "admitted", "chunks")
+
+
+# lint: host
+def validate_daemon_stats(doc: dict) -> dict:
+    """Structural check of a ``cache-sim/daemon-stats/v1`` snapshot
+    (the daemon ``stats`` socket op). Same contract as :func:`validate`:
+    raises ValueError listing every violation, returns the doc."""
+    errs = []
+    if not isinstance(doc, dict):
+        raise ValueError(f"stats must be a dict, got {type(doc).__name__}")
+    if doc.get("schema") != DAEMON_STATS_SCHEMA_ID:
+        errs.append(f"schema must be {DAEMON_STATS_SCHEMA_ID!r}, "
+                    f"got {doc.get('schema')!r}")
+    for k in _DAEMON_TOP_KEYS:
+        if k not in doc:
+            errs.append(f"missing key: {k}")
+    if doc.get("clock") not in ("monotonic", "virtual"):
+        errs.append(f"clock must be monotonic|virtual, "
+                    f"got {doc.get('clock')!r}")
+    jobs = doc.get("jobs")
+    if not isinstance(jobs, dict):
+        errs.append("jobs must be a dict")
+    else:
+        for k in _DAEMON_JOB_KEYS:
+            v = jobs.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"jobs.{k} must be a non-negative int, "
+                            f"got {v!r}")
+    lanes = doc.get("lanes")
+    if not isinstance(lanes, dict) or not lanes:
+        errs.append("lanes must be a non-empty dict")
+    else:
+        for name, lane in lanes.items():
+            if not isinstance(lane, dict):
+                errs.append(f"lane {name}: not a dict")
+                continue
+            for k in _DAEMON_LANE_KEYS:
+                if k not in lane:
+                    errs.append(f"lane {name}: missing key {k}")
+    buckets = doc.get("buckets")
+    if not isinstance(buckets, list):
+        errs.append("buckets must be a list")
+    else:
+        for i, b in enumerate(buckets):
+            if not isinstance(b, dict):
+                errs.append(f"bucket {i}: not a dict")
+                continue
+            for k in _DAEMON_BUCKET_KEYS:
+                if k not in b:
+                    errs.append(f"bucket {i}: missing key {k}")
+    for k in ("padding_waste", "single_shape_padding_waste"):
+        v = doc.get(k)
+        if v is not None and (not isinstance(v, (int, float))
+                              or isinstance(v, bool)
+                              or not 0.0 <= float(v) <= 1.0):
+            errs.append(f"{k} must be None or in [0, 1], got {v!r}")
+    if errs:
+        raise ValueError("invalid daemon stats:\n  " + "\n  ".join(errs))
     return doc

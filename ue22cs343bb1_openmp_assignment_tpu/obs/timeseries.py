@@ -153,3 +153,18 @@ def latency_summary(lat_s: Sequence[float],
     if queue_depth_peak is not None:
         doc["queue_depth_peak"] = int(queue_depth_peak)
     return doc
+
+
+# lint: host
+def lane_latency_summaries(spans: Sequence[dict]) -> Dict[str, dict]:
+    """Job-lifecycle spans → one :func:`latency_summary` block per
+    priority lane (the daemon's per-tenant latency metrics). Spans
+    without a ``lane`` annotation (serve/soak single-tenant runs)
+    group under ``"default"``; lanes sort lexicographically so the
+    dict is deterministic under a VirtualClock."""
+    by_lane: Dict[str, List[float]] = {}
+    for s in spans:
+        by_lane.setdefault(s.get("lane") or "default", []).append(
+            float(s["e2e_s"]))
+    return {lane: latency_summary(lat)
+            for lane, lat in sorted(by_lane.items())}

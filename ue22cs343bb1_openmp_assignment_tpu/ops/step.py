@@ -687,3 +687,60 @@ def run_wave_to_quiescence(cfg: SystemConfig, bstate: SimState,
     not reuse the donated input batch.
     """
     return batched_wave(cfg, bstate, chunk, max_cycles, message_phase)
+
+
+def batched_wave_chunk(cfg: SystemConfig, bstate: SimState, chunk: int,
+                       max_cycles: int, message_phase=None):
+    """Exactly one `chunk`-cycle masked slice of a batched wave.
+
+    Same per-cycle freeze body as batched_wave (done slots keep their
+    OLD state each cycle, so a finished job's state and cycle count
+    stay bit-identical to its solo run), but WITHOUT the outer
+    while-loop: the scheduler owns the loop. That is the continuous-
+    admission primitive (daemon/core.py): between chunks the daemon
+    swaps finished jobs out and admits queued jobs into the freed
+    slots via ``state.set_state`` while the other slots are still
+    mid-flight — the wave never stops for stragglers. Returns
+    ``(bstate, quiescent, done)``: the stepped batch plus the per-slot
+    quiescence mask and the resolved mask (quiescent OR out of cycle
+    budget), both [B] bools computed on device so the host fetch is
+    two tiny arrays, not the batch.
+    """
+    carry0, ro, blanks = _ro_outside(bstate)
+    step_all = jax.vmap(lambda s: cycle(cfg, s, message_phase=message_phase))
+    done_mask = jax.vmap(lambda s: s.quiescent())
+
+    def body(s, _):
+        full = s.replace(**ro)
+        done = done_mask(full) | (full.cycle >= max_cycles)
+        stepped = step_all(full)
+
+        def freeze(old, new):
+            return jnp.where(
+                done.reshape(done.shape + (1,) * (new.ndim - 1)), old, new)
+
+        out = jax.tree.map(freeze, full, stepped)
+        return out.replace(**blanks), None
+
+    s, _ = jax.lax.scan(body, carry0, None, length=chunk)
+    full = s.replace(**ro)
+    quiet = done_mask(full)
+    return full, quiet, quiet | (full.cycle >= max_cycles)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2, 3, 4),
+                   donate_argnums=(1,))
+def run_wave_chunk(cfg: SystemConfig, bstate: SimState, chunk: int = 16,
+                   max_cycles: int = 100_000, message_phase=None):
+    """jit-compiled batched_wave_chunk with the batch state donated.
+
+    One compile per (slot config, chunk, budget, protocol phase) —
+    the daemon keeps one compiled chunk stepper per shape bucket and
+    swaps jobs through it indefinitely (the bucketed prong of
+    analysis/lint_jaxpr.recompile_guard pins this). The caller must
+    not reuse the donated input batch; extraction of finished slots
+    reads the RETURNED batch (index_state) before the next chunk call
+    donates it back.
+    """
+    return batched_wave_chunk(cfg, bstate, chunk, max_cycles,
+                              message_phase)

@@ -338,9 +338,47 @@ class SpanBook:
         self._done.append(s)
 
     # lint: host
+    def annotate(self, job: str, **fields) -> None:
+        """Attach optional span fields (``lane``, ``bucket`` — the
+        daemon's tenancy annotations, obs.schema._SPAN_OPT_KEYS) to an
+        open span."""
+        self._open[job].update(fields)
+
+    # lint: host
     def spans(self) -> List[dict]:
         """Closed spans, in extraction order."""
         return list(self._done)
+
+    # lint: host
+    def prune(self, keep: int) -> int:
+        """Drop all but the newest ``keep`` closed spans (the serving
+        daemon's result-retention bound — latency summaries over a
+        pruned book are a sliding window); returns the drop count."""
+        drop = len(self._done) - keep
+        if drop > 0:
+            del self._done[:drop]
+        return max(drop, 0)
+
+
+# lint: host
+def weighted_padding_waste(waves: List[dict]) -> float:
+    """Summary padding_waste over per-wave records, weighted by each
+    wave's slot instruction budget::
+
+        1 - sum(real_instrs) / sum(slot_instr_budget)
+
+    An unweighted mean of the per-wave ``padding_waste`` fractions
+    over-counts small waves: with shape bucketing (daemon/bucketing)
+    waves run at DIFFERENT slot budgets, and a tiny well-packed bucket
+    wave must not cancel a huge badly-packed one. Weighting by budget
+    makes the summary the true fraction of issued slot capacity that
+    was padding — the number the bucketing win is measured in
+    (tests/test_serve.py pins a two-wave case where the two averages
+    disagree). serve/soak/daemon summaries all report THIS number.
+    """
+    budget = sum(w["slot_instr_budget"] for w in waves)
+    real = sum(w["real_instrs"] for w in waves)
+    return 1.0 - real / budget if budget else 0.0
 
 
 # lint: host
@@ -461,8 +499,6 @@ def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
     out_path = pathlib.Path(out_dir) if out_dir is not None else None
     job_docs: Dict[str, dict] = {}
     waves: List[dict] = []
-    slot_budget_total = 0
-    real_total = 0
     mb_dropped_total = 0
 
     for protocol, queue in by_proto.items():
@@ -527,8 +563,6 @@ def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
                 "padding_waste": 1.0 - real / budget,
                 "mb_dropped": wave_dropped,
             })
-            slot_budget_total += budget
-            real_total += real
             mb_dropped_total += wave_dropped
             if wave_dropped:
                 # loud on purpose, quiet or not: a silently dropped
@@ -603,8 +637,7 @@ def serve(specs, slots: int = 4, slot_nodes: Optional[int] = None,
         "wave_count": len(waves),
         "wall_s": wall,
         "jobs_per_sec": (n_jobs / wall) if wall > 0 else 0.0,
-        "padding_waste": (1.0 - real_total / slot_budget_total
-                          if slot_budget_total else 0.0),
+        "padding_waste": weighted_padding_waste(waves),
         "jobs": job_docs,
         "trace": serve_trace_doc(spans, clock.kind),
     }

@@ -55,7 +55,7 @@ from ue22cs343bb1_openmp_assignment_tpu.obs.clock import (MonotonicClock,
 from ue22cs343bb1_openmp_assignment_tpu.serve import (
     DEFAULT_MIX, JobSpec, SpanBook, build_job_arrays, build_job_state,
     job_config, protocol_phase, serve_trace_doc, slot_config,
-    _host_quiescent, _STATE_CACHE)
+    weighted_padding_waste, _host_quiescent, _STATE_CACHE)
 
 SCHEMA_ID = "cache-sim/soak/v1"
 INCIDENT_SCHEMA_ID = "cache-sim/soak-incident/v1"
@@ -155,8 +155,6 @@ def soak(arrivals, slots: int = 4, slot_nodes: Optional[int] = None,
     samples: List[Tuple[float, int, int]] = []
     waves: List[dict] = []
     job_docs: Dict[str, dict] = {}
-    slot_budget_total = 0
-    real_total = 0
     mb_dropped_total = 0
 
     while pending or queue or any(o is not None for o in occupant):
@@ -209,8 +207,6 @@ def soak(arrivals, slots: int = 4, slot_nodes: Optional[int] = None,
             "padding_waste": 1.0 - real / budget,
             "mb_dropped": wave_dropped,
         })
-        slot_budget_total += budget
-        real_total += real
         mb_dropped_total += wave_dropped
         if wave_dropped and not quiet:
             print(f"soak: WARNING wave {len(waves)} dropped "
@@ -256,8 +252,7 @@ def soak(arrivals, slots: int = 4, slot_nodes: Optional[int] = None,
         "wall_s": wall,
         "busy_s": busy_s,
         "drain_rate_jobs_per_s": drain,
-        "padding_waste": (1.0 - real_total / slot_budget_total
-                          if slot_budget_total else 0.0),
+        "padding_waste": weighted_padding_waste(waves),
         "mb_dropped": mb_dropped_total,
         "latency": latency,
         "series": timeseries.serve_series(samples),
@@ -269,6 +264,147 @@ def soak(arrivals, slots: int = 4, slot_nodes: Optional[int] = None,
         "trace": serve_trace_doc(spans, clock.kind),
     }
     return doc
+
+
+# lint: host
+def soak_daemon(arrivals, addr: str,
+                arrival_rate: Optional[float] = None,
+                lane_mix: Tuple[str, ...] = ("interactive", "batch"),
+                poll_s: float = 0.002, timeout_s: float = 300.0,
+                prefix: str = "", quiet: bool = True) -> dict:
+    """Drive the same open-loop arrival schedule through a RUNNING
+    daemon's socket instead of in-process waves.
+
+    The release loop is the client: each job is submitted at its
+    SCHEDULED arrival time on the client clock — releases never wait
+    for completions, so the stream stays coordinated-omission-free —
+    and jobs alternate through ``lane_mix`` (mixed interactive+batch
+    tenancy). The headline latency block is CLIENT-OBSERVED: scheduled
+    release → result available over the socket, the number a user of
+    the service experiences (queueing, scheduling, and transport
+    included). The embedded ``trace`` doc is the daemon's own span
+    book (server-side time base, exact queue_wait+run+extract == e2e
+    decomposition) — the two latency views are reported side by side,
+    not mixed, because they live on different clocks.
+
+    Backpressure rejections surface in ``doc["rejected"]`` and the
+    verdict; they are never silent and never touch ``mb_dropped``.
+
+    ``prefix`` is prepended to every job name: a daemon rejects
+    duplicate names, so successive soaks against the SAME daemon must
+    use distinct prefixes (the CLI derives one from ``--seed``).
+    """
+    import dataclasses
+    import time as _time
+
+    from ue22cs343bb1_openmp_assignment_tpu.daemon.client import (
+        DaemonClient)
+    from ue22cs343bb1_openmp_assignment_tpu.obs import timeseries
+
+    arrivals = sorted(
+        ((t, dataclasses.replace(spec, name=prefix + spec.name))
+         for t, spec in arrivals), key=lambda a: a[0])
+    if not arrivals:
+        raise ValueError("soak needs at least one arrival")
+    lanes = [lane_mix[i % len(lane_mix)] for i in range(len(arrivals))]
+
+    clock = MonotonicClock()
+    with DaemonClient(addr) as client:
+        client.wait_up(timeout_s=min(30.0, timeout_s))
+        t_start = clock.now()
+        deadline = t_start + timeout_s
+        pending = [(t_start + dt, spec, lane)
+                   for (dt, spec), lane in zip(arrivals, lanes)]
+        outstanding: Dict[str, Tuple[float, str]] = {}
+        done: Dict[str, dict] = {}
+        e2e: Dict[str, Tuple[float, str]] = {}
+        rejected: List[dict] = []
+        samples: List[Tuple[float, int, int]] = []
+        busy_now = 0
+        turn = 0
+        poll_names: List[str] = []
+        while pending or outstanding:
+            now = clock.now()
+            if now > deadline:
+                raise RuntimeError(
+                    f"daemon soak timed out after {timeout_s}s with "
+                    f"{len(outstanding)} job(s) outstanding")
+            while pending and pending[0][0] <= now:
+                t_sched, spec, lane = pending.pop(0)
+                r = client.submit(spec, lane=lane)
+                if r.get("status") == "queued":
+                    outstanding[spec.name] = (t_sched, lane)
+                else:
+                    rejected.append({"job": spec.name, "lane": lane,
+                                     "reason": r.get("reason",
+                                                     r.get("error"))})
+            # poll a bounded rotation of outstanding jobs per turn so
+            # release timing stays open-loop even with a deep backlog
+            if not poll_names:
+                poll_names = sorted(outstanding)
+            for name in poll_names[:8]:
+                if name not in outstanding:
+                    continue
+                r = client.result(name)
+                if r.get("status") == "done":
+                    t_sched, lane = outstanding.pop(name)
+                    e2e[name] = (clock.now() - t_sched, lane)
+                    done[name] = {
+                        "quiesced": bool(r["quiesced"]),
+                        "lane": r["lane"], "bucket": r["bucket"],
+                        "cycles": int(r["cycles"]),
+                    }
+            poll_names = poll_names[8:]
+            if turn % 20 == 0:
+                busy_now = sum(b["busy"]
+                               for b in client.stats()["buckets"])
+            samples.append((now - t_start, len(outstanding), busy_now))
+            turn += 1
+            if pending and not outstanding:
+                clock.sleep(max(0.0, pending[0][0] - clock.now()))
+            elif outstanding:
+                _time.sleep(poll_s)
+        wall = clock.now() - t_start
+        stats = client.stats()
+        trace = client.trace()
+
+    series_summary = timeseries.summarize_serve_series(samples)
+    lat_s = [v[0] for v in e2e.values()]
+    latency = timeseries.latency_summary(
+        lat_s, arrival_rate=arrival_rate,
+        queue_depth_peak=series_summary["queue_depth_peak"])
+    lane_latency = {
+        lane: timeseries.latency_summary(
+            [s for s, ln in e2e.values() if ln == lane])
+        for lane in sorted(set(lanes))}
+    drain = stats["drain_rate_jobs_per_s"]
+    return {
+        "schema": SCHEMA_ID,
+        "transport": "daemon",
+        "addr": addr,
+        "slots": sum(b["slots"] for b in stats["buckets"]),
+        "arrival_rate": arrival_rate,
+        "jobs_total": len(done) + len(rejected),
+        "jobs_quiesced": sum(1 for d in done.values() if d["quiesced"]),
+        "rejected": rejected,
+        "wave_count": stats["chunks"],
+        "wall_s": wall,
+        "busy_s": stats["busy_s"],
+        "drain_rate_jobs_per_s": drain,
+        "padding_waste": stats["padding_waste"] or 0.0,
+        "mb_dropped": stats["mb_dropped"],
+        "latency": latency,
+        "lane_latency": lane_latency,
+        "samples_ms": [round(s * 1e3, 6) for s in sorted(lat_s)],
+        "series": timeseries.serve_series(samples),
+        "series_summary": series_summary,
+        "verdict": backpressure_verdict(arrival_rate, drain,
+                                        series_summary),
+        "daemon_stats": stats,
+        "jobs": done,
+        "waves": [],
+        "trace": trace,
+    }
 
 
 # lint: host
@@ -410,6 +546,17 @@ def main(argv=None) -> int:
     ap.add_argument("--chunk", type=int, default=32)
     ap.add_argument("--max-cycles", type=int, default=100_000)
     ap.add_argument("--queue-capacity", type=int, default=64)
+    ap.add_argument("--daemon", default=None, metavar="ADDR",
+                    help="drive the stream through a RUNNING "
+                         "`cache-sim daemon` at this address (unix "
+                         "path or tcp:HOST:PORT) instead of "
+                         "in-process waves; latency is then "
+                         "client-observed over the socket")
+    ap.add_argument("--lane-mix", default="interactive,batch",
+                    help="comma list of lanes jobs alternate through "
+                         "under --daemon (default interactive,batch)")
+    ap.add_argument("--timeout", type=float, default=300.0,
+                    help="--daemon run bound in seconds (default 300)")
     ap.add_argument("--virtual-clock", action="store_true",
                     help="run on the deterministic VirtualClock "
                          "(byte-identical trace docs; tests/CI)")
@@ -434,17 +581,29 @@ def main(argv=None) -> int:
     if args.cpu:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
     slo = parse_slo(args.slo) if args.slo else None
+    if args.daemon and args.virtual_clock:
+        ap.error("--daemon measures real client-observed latency over "
+                 "the socket; it cannot run on --virtual-clock "
+                 "(the daemon owns its own clock)")
 
-    clock = (VirtualClock(wave_s=args.wave_s) if args.virtual_clock
-             else MonotonicClock())
     arrivals = soak_stream(args.arrival_rate, args.duration,
                            nodes=args.nodes, trace_len=args.trace_len,
                            protocol=args.protocol, seed=args.seed)
-    doc = soak(arrivals, slots=args.slots, chunk=args.chunk,
-               max_cycles=args.max_cycles,
-               queue_capacity=args.queue_capacity,
-               arrival_rate=args.arrival_rate, clock=clock,
-               quiet=False)
+    if args.daemon:
+        lane_mix = tuple(p.strip() for p in args.lane_mix.split(",")
+                         if p.strip())
+        doc = soak_daemon(arrivals, args.daemon,
+                          arrival_rate=args.arrival_rate,
+                          lane_mix=lane_mix, timeout_s=args.timeout,
+                          prefix=f"s{args.seed}.", quiet=False)
+    else:
+        clock = (VirtualClock(wave_s=args.wave_s)
+                 if args.virtual_clock else MonotonicClock())
+        doc = soak(arrivals, slots=args.slots, chunk=args.chunk,
+                   max_cycles=args.max_cycles,
+                   queue_capacity=args.queue_capacity,
+                   arrival_rate=args.arrival_rate, clock=clock,
+                   quiet=False)
     if args.out:
         pathlib.Path(args.out).write_text(
             json.dumps(doc, indent=2) + "\n")
@@ -456,11 +615,20 @@ def main(argv=None) -> int:
         lat_str = ("no jobs completed" if lat is None else
                    f"p50={lat['p50_ms']:.2f}ms p95={lat['p95_ms']:.2f}ms "
                    f"p99={lat['p99_ms']:.2f}ms")
-        print(f"soak: {doc['jobs_quiesced']}/{doc['jobs_total']} jobs "
-              f"quiesced in {doc['wave_count']} waves, {lat_str}, "
+        via = (f" via daemon {doc['addr']}"
+               if doc.get("transport") == "daemon" else "")
+        print(f"soak{via}: {doc['jobs_quiesced']}/{doc['jobs_total']} "
+              f"jobs quiesced in {doc['wave_count']} waves, {lat_str}, "
               f"queue_peak={v['queue_depth_peak']}, "
               f"drain={v['drain_rate_jobs_per_s']:.2f} jobs/s, "
               f"{'SATURATED' if v['saturated'] else 'keeping up'}")
+        for lane, ls in (doc.get("lane_latency") or {}).items():
+            if ls:
+                print(f"soak:   lane {lane}: p95={ls['p95_ms']:.2f}ms "
+                      f"({ls['jobs']} jobs)")
+        if doc.get("rejected"):
+            print(f"soak:   {len(doc['rejected'])} job(s) REJECTED "
+                  f"by backpressure (explicit, not dropped)")
     if slo:
         breaches = check_slo(doc["latency"], slo)
         if breaches:
