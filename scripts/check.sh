@@ -191,6 +191,28 @@ print(f"fused-round smoke: ok (routed ops exact, io-contract "
       f"{bpi:.1f} B/instr < xla 191377.95)")
 PYEOF
 
+# Kernel-contract smoke (30s box): the static verifier
+# (analysis/kernelcheck, `analyze --kernel`) must pass the traced
+# deep@4096 headline — re-deriving the contender cap from (chunk bits,
+# weight exponents, f32 mantissa), walking the traced body for the
+# VMEM liveness peak vs the device budget, and scanning the jaxpr for
+# non-lowerable primitives — and must CATCH a seeded ladder bug
+# (narrow_ladder_gap shrinks the weight-exponent gap; the derived cap
+# collapses below the headline's contenders — exit 1, the verifier's
+# own mutation test; static pass, arithmetic only).
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.analysis \
+    --kernel --skip-model-check --skip-lint
+rc=0
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.analysis \
+    --kernel --skip-model-check --skip-lint \
+    --mutation narrow_ladder_gap || rc=$?
+if [[ "$rc" != 1 ]]; then
+    echo "kernel-check smoke: seeded narrow_ladder_gap mutant was NOT"
+    echo "caught (exit $rc, want 1)"
+    exit 1
+fi
+echo "kernel-check smoke: ok (headline verified, seeded mutant caught)"
+
 # Serve smoke (30s box): 8 mixed-workload jobs packed into 4 slots
 # must all reach quiescence, and one job's batched dump must stay
 # byte-identical to its solo run (the per-tenant bit-parity gate the

@@ -1,0 +1,292 @@
+"""Kernel-contract verifier (analysis/kernelcheck) — the static
+VMEM/exactness/lowerability audit behind `analyze --kernel`.
+
+The load-bearing assertions RE-DERIVE the headline numbers from first
+principles rather than restating the module's constants: the contender
+cap comes out of an independent exact-rational evaluation of the
+summation-error lemma, the VMEM peak is cross-pinned against the
+kernel's io-contract byte count, and every seeded mutant in
+analysis.mutations.KERNEL_MUTATIONS must be killed by the static
+passes alone (trace=False).
+"""
+
+import dataclasses
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from ue22cs343bb1_openmp_assignment_tpu.analysis import kernelcheck as kc
+from ue22cs343bb1_openmp_assignment_tpu.analysis import mutations
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_round as pr
+
+
+def _deep(n, dd=2, tw=2, **kw):
+    return dataclasses.replace(
+        SystemConfig.scale(num_nodes=n, drain_depth=dd, txn_width=tw),
+        **{"deep_window": True, "deep_slots": 3,
+           "deep_ownerval_slots": 1, **kw})
+
+
+# ---------------------------------------------------------------- pass 1:
+# exact arithmetic
+
+
+def test_exact_cap_rederived_independently():
+    """The certified cap at the production ladder (G=15, f32) must be
+    2**14 — derived here by a brute linear scan of the lemma
+    ``R * (1 + eps)**(R - 1) < 2**G`` in exact rationals around the
+    bisection's answer, NOT by comparing against a copied constant."""
+    cap = kc.exact_cap(15)
+    eps = Fraction(1, 1 << 24)
+    # independent check of maximality: cap satisfies the bound, its
+    # successor does not (the tightness witness)
+    assert cap * (1 + eps) ** (cap - 1) < 1 << 15
+    assert (cap + 1) * (1 + eps) ** cap >= 1 << 15
+    b = kc.derived_bounds(kc.headline_config())
+    assert b["cap_exact"] == cap
+    # the gate's power-of-two sub-cap: the legacy hand-proved 2**14
+    # must fall out of the derivation at the current ladder params
+    assert b["cap_limit"] == 1 << 14
+    assert b["cap_limit"] <= cap < 2 * b["cap_limit"]
+
+
+def test_derived_bounds_headline():
+    b = kc.derived_bounds(kc.headline_config())
+    assert (b["A"], b["G"], b["chunk_bits"]) == (100, 15, 4)
+    # L = prio(12) + valid(1) + slot_bits; 4 passes of 4 bits
+    assert b["num_passes"] == -(-b["L_bits"] // b["chunk_bits"])
+    # ladder spans normal f32 only — re-derived from the params
+    assert b["ladder_min_exp"] == b["A"] - b["G"] * 15 >= kc.F32_MIN_EXP
+    assert b["ladder_max_exp"] == b["A"] + b["G"] <= kc.F32_MAX_EXP
+    # one contender per (node, entry) at deep_waves=1
+    assert b["max_contenders"] == 4096
+
+
+def test_exactness_clean_at_headline():
+    rep = kc.check_exactness(kc.headline_config())
+    assert rep["ok"], rep["findings"]
+    assert rep["lemmas"]["cap_margin_symbolic"]
+    assert rep["lemmas"]["readout_adversarial_f32"]
+
+
+def test_exactness_flags_cap_boundary():
+    """A config whose per-entry contenders reach the certified cap is a
+    `contender_cap` finding (the analyzer's cap+1 adversary: 16384
+    single-wave nodes == 2**14 contenders, not strictly under)."""
+    rep = kc.check_exactness(_deep(16384, deep_slots=2))
+    kinds = [f["kind"] for f in rep["findings"]]
+    assert "contender_cap" in kinds
+    # one node fewer is strictly under the cap: clean
+    assert kc.check_exactness(_deep(8192, deep_slots=2))["ok"]
+
+
+def test_scatter_min_exact_at_derived_cap():
+    """Runtime witness for the derived cap: cap_limit contenders piled
+    on one entry (the analyzer-certified maximum for a <-cap config)
+    still recover the exact minimum, at adversarial chunk values."""
+    from ue22cs343bb1_openmp_assignment_tpu.ops import deep_engine as de
+    cfg = _deep(8)
+    ix = pr.RoutedIndexOps(cfg, 3)
+    nat = de.XlaIndexOps()
+    L = ix._L
+    R = kc.derived_bounds(cfg)["cap_limit"]
+    rng = np.random.default_rng(3)
+    import jax.numpy as jnp
+    M = 16
+    low = rng.integers(0, 1 << L, R).astype(np.int32)
+    low[:-1] = (1 << L) - 1          # crowd at the worst chunk...
+    low[-1] = 1                      # ...one true minimum hiding below
+    idx = np.zeros(R, np.int32)      # ALL on entry 0
+    vals = jnp.asarray((int(ix._cd) << L) | low)
+    dest = jnp.full((M,), 2 ** 31 - 1, jnp.int32)
+    got = ix.scatter_min(dest, jnp.asarray(idx), vals)
+    want = nat.scatter_min(dest, jnp.asarray(idx), vals)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_split16_join16_roundtrip_extremes():
+    """The one-hot matmul routing's int32 <-> two-exact-f32-halves side
+    contract, at the integer extremes and the half boundaries."""
+    import jax.numpy as jnp
+    v = jnp.asarray(np.array(
+        [0, 1, -1, 2 ** 31 - 1, -(2 ** 31), 0x7FFF8000, -0x8000,
+         0xFFFF, 0x10000, -0x10000], np.int64).astype(np.int32))
+    halves = pr._split16(v[:, None])
+    # each half must be a nonnegative integer < 2**16: exact in f32
+    h = np.asarray(halves)
+    assert h.dtype == np.float32
+    assert (h >= 0).all() and (h < 2 ** 16).all()
+    assert (h == np.trunc(h)).all()
+    lo, hi = halves[:, 0], halves[:, 1]
+    np.testing.assert_array_equal(np.asarray(pr._join16(lo, hi)),
+                                  np.asarray(v))
+
+
+def test_mutants_killed_statically():
+    """Every seeded kernel mutant must be caught by the static passes
+    alone — no trace, no execution — with its documented finding
+    kind."""
+    for name, (cm, kind) in mutations.KERNEL_MUTATIONS.items():
+        with cm():
+            rep = kc.check(trace=False)
+        kinds = [f["kind"] for f in rep["findings"]]
+        assert not rep["ok"] and kind in kinds, (name, kinds)
+    # and the unmutated world is clean again (mutators restore state)
+    assert kc.check(trace=False)["ok"]
+
+
+# ---------------------------------------------------------------- pass 2:
+# VMEM
+
+
+def test_vmem_verdict_boundaries():
+    """The budget rule's boundary semantics: exactly-at-budget passes,
+    one byte over fails; multi-step grids pay input headroom."""
+    v = kc.vmem_verdict(600, 400, None, grid_steps=1, vmem_bytes=1000)
+    assert v["ok"] and v["required_bytes"] == 1000
+    v = kc.vmem_verdict(601, 400, None, grid_steps=1, vmem_bytes=1000)
+    assert not v["ok"] and v["required_bytes"] == 1001
+    # traced peak dominates resident when larger
+    v = kc.vmem_verdict(600, 400, 1001, grid_steps=1, vmem_bytes=1000)
+    assert not v["ok"]
+    # a 2-step grid double-buffers its inputs
+    v = kc.vmem_verdict(300, 100, None, grid_steps=2, vmem_bytes=700)
+    assert v["ok"] and v["headroom_bytes"] == 300 \
+        and v["required_bytes"] == 700
+    assert not kc.vmem_verdict(300, 100, None, grid_steps=2,
+                               vmem_bytes=699)["ok"]
+
+
+def test_resident_bytes_cross_pinned_to_io_contract():
+    """The block-table resident bytes ARE the kernel's HBM I/O contract
+    (one VMEM load + one store of every block) — two independently
+    maintained shape tables that must never drift."""
+    cfg = kc.headline_config()
+    r_in, r_out = kc.resident_bytes(cfg)
+    io_in, io_out = pr.io_contract_bytes(cfg)
+    assert (r_in, r_out) == (io_in, io_out)
+    assert r_in + r_out == 5_079_040      # the pinned headline contract
+
+
+@pytest.mark.slow
+def test_traced_vmem_peak_headline():
+    """The liveness walk over the real traced body at deep@4096: the
+    peak must land in the documented ~13 MB window and fit the 16 MiB
+    budget with the resident blocks accounted."""
+    cfg = kc.headline_config()
+    rows = kc.vmem_rows(cfg, device_kind="cpu", trace=True)
+    (row,) = rows
+    assert row["basis"] == "traced-liveness"
+    assert row["ok"]
+    assert 11_500_000 < row["peak_bytes"] < 14_500_000
+    assert row["required_bytes"] <= 16 * 2 ** 20
+    # grid (1,): no double-buffer headroom
+    assert row["headroom_bytes"] == 0
+
+
+def test_peak_live_bytes_on_synthetic_jaxpr():
+    """The liveness model itself, on a program small enough to verify
+    by hand: b = a + a frees nothing (a lives on), c = b * b frees b
+    before allocating c under in-place reuse."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(a):
+        b = a + a          # live: a(400) + b(400) = 800
+        c = b * b          # b dies here: 400 freed, c(400) allocated
+        return c + a       # a dies; out 400
+
+    closed = jax.make_jaxpr(f)(jnp.zeros((10, 10), jnp.float32))
+    # peak = a + b live simultaneously = 800 bytes
+    assert kc.peak_live_bytes(closed.jaxpr) == 800
+
+
+# ---------------------------------------------------------------- pass 3:
+# lowerability
+
+
+def test_lowerability_clean_on_small_trace():
+    rep = kc.check_lowerability(_deep(8))
+    assert rep["ok"], rep["findings"]
+    assert rep["eqns"] > 1000      # the whole round really is in there
+
+
+def test_lowerability_flags_banned_primitives():
+    import jax
+    import jax.numpy as jnp
+
+    def bad(x, i):
+        return jnp.sort(x)[i[0]] + x.astype(jnp.float64).sum()
+
+    # x64 must be on for the float64 widening to survive tracing
+    # (without it astype truncates to f32 and the bug self-heals)
+    with jax.experimental.enable_x64():
+        closed = jax.make_jaxpr(bad)(
+            jnp.zeros((8,), jnp.float32), jnp.zeros((1,), jnp.int32))
+    findings = []
+    kc.audit_lowerability(closed.jaxpr, findings, target="t")
+    kinds = {f["kind"] for f in findings}
+    assert "mosaic_lowerability" in kinds      # sort / gather
+    assert "wide_dtype" in kinds               # float64
+
+
+# ---------------------------------------------------------------- pass 4:
+# gates — supported() consumes the derived bounds
+
+
+def test_gate_widened_for_single_wave():
+    """The derivation splits the legacy slots*N product bound: deep@8192
+    q3 single-wave (24576 under the old bound) is now ADMITTED, its
+    multi-wave sibling is not, and the cap boundary stays rejected."""
+    assert pr.supported(_deep(8192))
+    assert not pr.supported(_deep(8192, deep_waves=2))
+    assert not pr.supported(_deep(16384, deep_slots=2))
+    # storm remains a structural gate regardless of contender margin
+    assert not pr.supported(_deep(256, deep_read_storm=True,
+                                  deep_ownerval_slots=2))
+
+
+def test_check_gates_records_widening():
+    rep = kc.check_gates()
+    assert rep["ok"], rep["findings"]
+    p = rep["probes"]
+    assert p["widened_8192_q3_w1"]["supported"]
+    assert p["widened_8192_q3_w1"]["widened"]
+    assert not p["widened_8192_q3_w1"]["legacy_product_bound"]
+    assert not p["multiwave_8192_q3_w2"]["supported"]
+    assert not p["cap_boundary_16384"]["supported"]
+    assert not p["storm_256"]["supported"]
+    assert p["headline_4096"]["supported"]
+
+
+# ---------------------------------------------------------------- the CLI
+
+
+def test_runner_kernel_prong_exit_codes(capsys):
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import runner
+    rc = runner.main(["--kernel", "--kernel-static", "--kernel-nodes",
+                      "256", "--skip-model-check", "--skip-lint"])
+    assert rc == 0
+    assert "kernel contracts: ok" in capsys.readouterr().out
+    rc = runner.main(["--kernel", "--skip-model-check", "--skip-lint",
+                      "--mutation", "widen_min_chunk"])
+    assert rc == 1
+    assert "ladder_range" in capsys.readouterr().out
+
+
+def test_runner_rejects_kernel_mutation_elsewhere():
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import runner
+    with pytest.raises(SystemExit, match="kernel mutation"):
+        runner.main(["--skip-lint", "--mutation", "widen_min_chunk"])
+
+
+def test_report_render_and_schema():
+    rep = kc.check(_deep(256), trace=False)
+    assert rep["schema"] == kc.SCHEMA and rep["ok"]
+    lines = kc.render_text(rep)
+    assert any("kernel contracts: ok" in ln for ln in lines)
+    assert any("cap 16384" in ln for ln in lines)
+    import json
+    json.dumps(rep)      # the --json path must serialize as-is

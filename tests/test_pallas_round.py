@@ -127,12 +127,23 @@ def test_supported_gate():
     assert not pr.supported(
         dataclasses.replace(cfg, deep_read_storm=True))
     assert not pr.supported(dataclasses.replace(cfg, deep_window=False))
-    # the scatter-min rounding margin: deep_slots * nodes < 2**14
+    # the scatter-min rounding margin is analyzer-derived
+    # (analysis/kernelcheck): per-entry contenders N * (slots if
+    # waves > 1 else 1) must stay under the certified cap 2**14.  At
+    # waves=1 the window dup-stop admits at most one same-entry event
+    # per node, so 8192 nodes with 3 slots is 8192 contenders — ADMITTED
+    # now (the legacy slots*nodes < 2**14 product bound rejected it)
     big = SystemConfig.scale(num_nodes=8192, drain_depth=2,
                              txn_width=2)
     big = dataclasses.replace(big, deep_window=True, deep_slots=3)
-    assert not pr.supported(big)
-    assert pr.supported(dataclasses.replace(big, deep_slots=1))
+    assert pr.supported(big)
+    # multi-wave multiplies contenders by slots: 8192*3 over the cap
+    assert not pr.supported(dataclasses.replace(big, deep_waves=2))
+    # N alone at the cap boundary: 16384 contenders == 2**14 rejected
+    huge = SystemConfig.scale(num_nodes=16384, drain_depth=2,
+                              txn_width=2)
+    assert not pr.supported(
+        dataclasses.replace(huge, deep_window=True, deep_slots=2))
 
 
 def test_io_contract_bytes_pinned_headline():

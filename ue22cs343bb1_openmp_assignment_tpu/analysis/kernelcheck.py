@@ -1,0 +1,648 @@
+"""Static kernel-contract verifier for the fused Pallas round.
+
+The fused round kernel (ops/pallas_round) rests on three contracts
+that used to live as hand proofs in PERF.md prose and a hard-coded
+``2**14`` in ``supported()``:
+
+1. **Exact arithmetic** — the chunked-exponent scatter-min ladder
+   recovers the per-entry minimum chunk exactly provided the per-entry
+   contender count stays under a rounding cap. This module *derives*
+   that cap from the ladder parameters (chunk bits, weight-exponent
+   gap G, f32 mantissa width) instead of trusting the constant, checks
+   the f32 range the ladder spans, and machine-checks the
+   rounding-safety lemmas: the symbolic summation-error bound in exact
+   rational arithmetic (`fractions`) and the min-chunk readout on
+   adversarial contender multisets evaluated in real float32.
+
+2. **VMEM footprint** — the kernel keeps all round state resident in
+   VMEM, so its peak live bytes must fit the device's VMEM. The
+   resident I/O side comes from the kernel's own block-shape table
+   (``pallas_round._block_shapes`` — the same table ``_call_round``
+   builds its BlockSpecs from); the transient side comes from a
+   liveness walk over the traced jaxpr of ``pallas_round._round_body``
+   — the code object the kernel actually runs. Budgets come from the
+   per-device ``vmem_bytes`` column of obs/roofline's peaks table.
+
+3. **Mosaic lowerability** — the same traced jaxpr is audited for
+   primitives that do not lower on TPU (vector gather/scatter, sort,
+   64-bit dtypes, dynamic shapes, host callbacks), so
+   interpret-mode-only surprises become a named findings list.
+
+The payoff (`derived_bounds`): ``pallas_round.supported()`` delegates
+its contender gate here. The derivation splits the legacy
+``deep_slots * num_nodes`` bound into its two real factors — the
+*rounding cap* (a pure ladder property, ``cap_limit``) and the
+*per-entry contender count* (an engine property: at ``deep_waves ==
+1`` the window's dup stop admits at most ONE same-entry event per node
+per round, ops/deep_fold, so contenders <= N rather than N * Q) —
+which WIDENS the gate for single-wave configs: deep@8192 with 3 slots
+was rejected by the legacy product bound (24576 >= 2**14) and is
+admitted by the derived one (8192 < 2**14). Read-storm stays a
+*structural* gate, not a margin: duplicate-row storm commits break the
+routed scatters' uniqueness contract (ops/deep_engine raises on
+storm + non-native index ops), which no rounding analysis can lift.
+
+Seeded mutants in analysis/mutations.KERNEL_MUTATIONS perturb the
+real kernel parameters (chunk width, exponent gap, the gate itself)
+and tests require every one to be caught statically — the verifier's
+own regression suite, in the verify_table / model-checker tradition.
+CLI surface: ``cache-sim analyze --kernel`` (analysis/runner.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from fractions import Fraction
+from typing import List, Optional
+
+import numpy as np
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+
+SCHEMA = "cache-sim/kernelcheck/v1"
+
+#: IEEE-754 binary32: significand precision (bits), normal exponent
+#: range. The ladder routes powers of two and sums them on the MXU in
+#: f32 — these three numbers are where every derived margin comes from.
+F32_MANTISSA = 24
+F32_MIN_EXP = -126
+F32_MAX_EXP = 127
+
+#: banned-primitive patterns for the Mosaic-lowerability lint: TPU
+#: Pallas has no vector gather/scatter or sort lowering, and host
+#: round-trips cannot appear inside a kernel body. (Checked against
+#: the *traced* body — the routed one-hot design exists precisely so
+#: none of these occur; a regression reintroducing one shows up here
+#: before the first real-TPU compile.)
+_BANNED_EXACT = ("gather", "sort", "top_k", "infeed", "outfeed")
+_BANNED_PREFIX = ("scatter",)
+_WIDE_DTYPES = ("int64", "uint64", "float64")
+
+
+# ---------------------------------------------------------------------------
+# pass 1: exact arithmetic — derive the ladder cap, check the lemmas
+# ---------------------------------------------------------------------------
+
+def _ladder_params() -> tuple:
+    """(A, G, chunk_bits) read from the kernel module — the analyzer
+    audits the constants the kernel actually routes with, so seeded
+    mutations of those constants are visible here."""
+    from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_round as pr
+    return pr._MIN_A, pr._MIN_G, pr._MIN_CHUNK_BITS
+
+
+@functools.lru_cache(maxsize=None)
+def exact_cap(G: int, mantissa: int = F32_MANTISSA) -> int:
+    """Largest contender count R whose worst-case rounded ladder sum
+    provably stays under the next chunk threshold, in exact rational
+    arithmetic.
+
+    All contenders of a pass route weights <= w_m (m the true minimum
+    chunk), so the exact sum is <= R * w_m; the standard
+    any-summation-order forward error bound gives ``fl(sum) <= sum *
+    (1 + eps)**(R - 1)`` with ``eps = 2**-mantissa``. Recovery needs
+    ``fl(sum) < 2**G * w_m`` (the next threshold up), so the cap is
+    the largest R with ``R * (1 + eps)**(R - 1) < 2**G`` — evaluated
+    with `fractions.Fraction` (no float anywhere), found by bisection.
+    ~32.7k at G=15/f32."""
+    eps = Fraction(1, 1 << mantissa)
+    lim = 1 << G
+
+    def safe(R: int) -> bool:
+        return R * (1 + eps) ** (R - 1) < lim
+
+    lo, hi = 1, lim          # safe(1) trivially; safe(2**G) false
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        if safe(mid):
+            lo = mid
+        else:
+            hi = mid
+    return lo
+
+
+def derived_bounds(cfg: SystemConfig) -> dict:
+    """The fused-round gate quantities, derived per config.
+
+    - ``cap_exact``: the exact-rational rounding cap (`exact_cap`).
+    - ``cap_limit``: the certified cap the gate uses — the largest
+      power of two <= cap_exact. The spare sub-doubling margin absorbs
+      accumulation-model slop (the MXU's internal summation order and
+      FMA behavior are not architecturally pinned); at G=15 this lands
+      exactly on the legacy hand-proved 2**14.
+    - ``max_contenders``: the per-entry contender bound. The
+      scatter-min sums are PER ENTRY, so only same-entry contention
+      matters: one lane event per (node, entry) at ``deep_waves == 1``
+      (the dup window-stop, ops/deep_fold — a second remote event on
+      an already-slotted entry stops the window), ``deep_slots`` per
+      node otherwise (slot-keyed re-touches compose across waves).
+    """
+    A, G, cb = _ladder_params()
+    nvals = 1 << cb
+    N = cfg.num_nodes
+    from ue22cs343bb1_openmp_assignment_tpu.ops.sync_engine import \
+        slot_bits
+    prio_bits = max(1, (N - 1).bit_length())
+    L = (prio_bits + 1 + slot_bits(cfg)
+         + (1 if cfg.deep_read_storm else 0))
+    cap = exact_cap(G)
+    return {
+        "A": A, "G": G, "chunk_bits": cb,
+        "L_bits": L, "num_passes": max(1, -(-L // cb)),
+        "ladder_min_exp": A - G * (nvals - 1),
+        "ladder_max_exp": A + G,
+        "cap_exact": cap,
+        "cap_limit": 1 << (cap.bit_length() - 1),
+        "contenders_per_node": 1 if cfg.deep_waves == 1
+        else cfg.deep_slots,
+        "max_contenders": N * (1 if cfg.deep_waves == 1
+                               else cfg.deep_slots),
+    }
+
+
+def _decode_chunk(ssum: np.float32, A: int, G: int, nvals: int) -> int:
+    """The kernel's min-chunk readout (_route_min's threshold count),
+    replicated on one scalar f32 sum."""
+    c = 0
+    for v in range(nvals):
+        if ssum < np.float32(2.0 ** (A - G * v)):
+            c += 1
+    return min(c, nvals - 1)
+
+
+def _f32_sum(weights: np.ndarray) -> np.float32:
+    """Strict sequential round-to-nearest f32 accumulation — one
+    admissible order under the any-order error bound exact_cap
+    certifies against."""
+    acc = np.float32(0.0)
+    for w in weights:
+        acc = np.float32(acc + np.float32(w))
+    return acc
+
+
+def check_exactness(cfg: SystemConfig) -> dict:
+    """Pass 1: derive the caps and machine-check the rounding lemmas.
+
+    Findings:
+    - ``ladder_range``: a ladder weight or threshold leaves f32's
+      normal range (weights must be *exact* powers of two — a
+      subnormal/overflowed rung breaks the readout silently).
+    - ``rounding_lemma``: a machine-checked lemma failed — either the
+      symbolic cap margin (exact rational arithmetic) or a concrete
+      adversarial-multiset readout evaluated in real float32.
+    - ``contender_cap``: this config's per-entry contender bound
+      reaches the certified cap.
+    """
+    b = derived_bounds(cfg)
+    A, G, cb = b["A"], b["G"], b["chunk_bits"]
+    nvals = 1 << cb
+    findings: List[dict] = []
+
+    def find(kind, detail):
+        findings.append({"pass": "exactness", "kind": kind,
+                         "detail": detail})
+
+    # f32 range: every rung and every threshold must be a normal,
+    # exactly-representable power of two, and the worst-case rounded
+    # sum (< 2**(A+G) by the cap lemma) must not overflow
+    if b["ladder_min_exp"] < F32_MIN_EXP:
+        find("ladder_range",
+             f"lowest rung 2**{b['ladder_min_exp']} is below f32's "
+             f"minimum normal 2**{F32_MIN_EXP} "
+             f"(A={A}, G={G}, {nvals}-value chunks)")
+    if b["ladder_max_exp"] > F32_MAX_EXP:
+        find("ladder_range",
+             f"threshold headroom 2**{b['ladder_max_exp']} exceeds "
+             f"f32's maximum exponent 2**{F32_MAX_EXP}")
+    # 16-bit-halves side contract of the one-hot matmuls: each half
+    # must be an exact f32 integer
+    if 16 > F32_MANTISSA:
+        find("ladder_range",
+             "16-bit halves no longer exact in the routing float")
+
+    lemmas = {}
+    if not findings:
+        # lemma: symbolic cap margin, exact rational arithmetic —
+        # cap_exact is the LARGEST safe count (its successor violates
+        # the bound: the tightness witness), and cap_limit is a power
+        # of two at or under it
+        eps = Fraction(1, 1 << F32_MANTISSA)
+        cap, lim = b["cap_exact"], b["cap_limit"]
+        ok_cap = (cap * (1 + eps) ** (cap - 1) < (1 << G)
+                  <= (cap + 1) * (1 + eps) ** cap)
+        ok_lim = lim <= cap and lim == 1 << (lim.bit_length() - 1)
+        lemmas["cap_margin_symbolic"] = bool(ok_cap and ok_lim)
+        if not lemmas["cap_margin_symbolic"]:
+            find("rounding_lemma",
+                 f"symbolic cap margin failed: cap_exact={cap}, "
+                 f"cap_limit={lim}, G={G}")
+
+        # lemma: adversarial f32 readouts. R contenders, true minimum
+        # chunk m — the readout must decode m for (a) a single
+        # contender (threshold-equality edge), (b) cap_limit - 1
+        # contenders all at m (largest admissible exact sum), (c) a
+        # mixed multiset: bulk at m plus one contender at every deeper
+        # chunk, summed ascending and descending (rounding-order
+        # adversaries under the any-order bound).
+        R = b["cap_limit"] - 1
+        ok = True
+        for m in range(nvals):
+            w_m = np.float32(2.0 ** (A - G * m))
+            cases = [np.full(1, w_m, np.float32),
+                     np.full(R, w_m, np.float32)]
+            deeper = np.array([2.0 ** (A - G * v)
+                               for v in range(m + 1, nvals)], np.float32)
+            if deeper.size:
+                mix = np.concatenate(
+                    [np.full(R - deeper.size, w_m, np.float32), deeper])
+                cases += [np.sort(mix), np.sort(mix)[::-1]]
+            for arr in cases:
+                got = _decode_chunk(_f32_sum(arr), A, G, nvals)
+                if got != m:
+                    ok = False
+                    find("rounding_lemma",
+                         f"f32 readout decoded chunk {got}, want {m} "
+                         f"({arr.size} contenders)")
+                    break
+        lemmas["readout_adversarial_f32"] = ok
+
+    if b["max_contenders"] >= b["cap_limit"]:
+        find("contender_cap",
+             f"per-entry contenders {b['max_contenders']} "
+             f"(N={cfg.num_nodes} x {b['contenders_per_node']}/node at "
+             f"deep_waves={cfg.deep_waves}) >= certified cap "
+             f"{b['cap_limit']}")
+
+    return {"bounds": b, "lemmas": lemmas, "findings": findings,
+            "ok": not findings}
+
+
+# ---------------------------------------------------------------------------
+# pass 2 + 3 shared: trace the real kernel body
+# ---------------------------------------------------------------------------
+
+def trace_round_body(cfg: SystemConfig):
+    """``jax.make_jaxpr`` over ``pallas_round._round_body`` at this
+    config's block shapes — the exact code object ``_round_kernel``
+    wraps between its VMEM load and store. Abstract tracing only:
+    nothing executes, no pallas grid is entered."""
+    import jax
+    import jax.numpy as jnp
+    from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_round as pr
+    ins, _ = pr._block_shapes(cfg)
+    args = [jax.ShapeDtypeStruct(s, jnp.int32) for s in ins]
+    return jax.make_jaxpr(functools.partial(pr._round_body, cfg))(*args)
+
+
+def _subjaxprs(v):
+    vs = v if isinstance(v, (list, tuple)) else [v]
+    for s in vs:
+        if hasattr(s, "jaxpr"):        # ClosedJaxpr
+            yield s.jaxpr
+        elif hasattr(s, "eqns"):       # raw Jaxpr
+            yield s
+
+
+def _nbytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dt = getattr(aval, "dtype", None)
+    if shape is None or dt is None:
+        return 0
+    n = 1
+    for d in shape:
+        if not isinstance(d, int):
+            return 0
+        n *= d
+    return n * dt.itemsize
+
+
+def peak_live_bytes(jaxpr) -> int:
+    """Peak simultaneously-live bytes of one jaxpr under a last-use
+    liveness model with in-place reuse.
+
+    Walk the equations in order tracking the live set (a value is live
+    from its defining equation to its last use; jaxpr outputs live to
+    the end). At each equation, operands whose last use is *this*
+    equation are freed before the outputs allocate — the buffer-reuse
+    model real allocators (XLA buffer assignment, Mosaic's VMEM
+    allocator) apply to dying operands. Sub-jaxprs (fori_loop bodies,
+    pjit calls) contribute ``max(0, inner peak - inner input bytes)``
+    on top of the outer live set: their inputs alias outer buffers
+    already counted.
+
+    The walk is deterministic per traced program, so the number can be
+    pinned in tests and gated in CI like any other static contract."""
+    from jax.core import DropVar, Literal
+    last = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if not isinstance(v, Literal):
+                last[v] = i
+    for v in jaxpr.outvars:
+        if not isinstance(v, Literal):
+            last[v] = len(jaxpr.eqns)
+    live = {}
+    for v in list(jaxpr.invars) + list(jaxpr.constvars):
+        if last.get(v, -1) >= 0:
+            live[v] = _nbytes(v.aval)
+    cur = sum(live.values())
+    peak = cur
+    for i, eqn in enumerate(jaxpr.eqns):
+        freed = 0
+        for v in set(x for x in eqn.invars if not isinstance(x, Literal)):
+            if last.get(v) == i and v in live:
+                freed += live.pop(v)
+        outb = sum(_nbytes(v.aval) for v in eqn.outvars)
+        inner = 0
+        for par in eqn.params.values():
+            for sub in _subjaxprs(par):
+                sub_in = sum(_nbytes(v.aval) for v in
+                             list(sub.invars) + list(sub.constvars))
+                inner = max(inner,
+                            max(0, peak_live_bytes(sub) - sub_in))
+        peak = max(peak, cur - freed + outb + inner)
+        cur -= freed
+        for v in eqn.outvars:
+            if not isinstance(v, DropVar) and last.get(v, -1) > i:
+                b = _nbytes(v.aval)
+                live[v] = b
+                cur += b
+    return peak
+
+
+# ---------------------------------------------------------------------------
+# pass 2: static VMEM footprint
+# ---------------------------------------------------------------------------
+
+def resident_bytes(cfg: SystemConfig) -> tuple:
+    """(input_bytes, output_bytes) resident in VMEM for the fused
+    round's pallas_call blocks, from the kernel's own block-shape
+    table (all blocks int32)."""
+    from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_round as pr
+    ins, outs = pr._block_shapes(cfg)
+    return (4 * sum(r * c for r, c in ins),
+            4 * sum(r * c for r, c in outs))
+
+
+def vmem_verdict(resident_in: int, resident_out: int,
+                 peak_bytes: Optional[int], grid_steps: int,
+                 vmem_bytes: int) -> dict:
+    """The budget rule, factored out so boundary semantics are pinned
+    by tests: required = max(resident, traced peak) + double-buffer
+    headroom, failing strictly over budget (exactly-at-budget passes).
+
+    Headroom: a multi-step grid revolves its input blocks (two copies
+    in flight while the pipeline overlaps copy-in with compute), so
+    headroom = resident inputs again; the fused round runs the whole
+    round at grid (1,) — single buffering, no headroom."""
+    resident = resident_in + resident_out
+    headroom = resident_in if grid_steps > 1 else 0
+    required = max(resident, peak_bytes or 0) + headroom
+    return {"resident_in_bytes": int(resident_in),
+            "resident_out_bytes": int(resident_out),
+            "peak_bytes": None if peak_bytes is None else int(peak_bytes),
+            "grid_steps": int(grid_steps),
+            "headroom_bytes": int(headroom),
+            "required_bytes": int(required),
+            "vmem_bytes": int(vmem_bytes),
+            "ok": required <= vmem_bytes}
+
+
+def vmem_rows(cfg: SystemConfig, device_kind: Optional[str] = None,
+              trace: bool = True, closed=None) -> list:
+    """Per-kernel VMEM rows (the fused round is the only kernel with
+    whole-round state residency; the fold/window kernels stream [1, N]
+    blocks and are budgeted by the same rule trivially). With
+    ``trace=False`` only the static block-table side is accounted —
+    the cheap, always-deterministic row perf-report embeds. ``closed``
+    shares an already-traced body across passes."""
+    from ue22cs343bb1_openmp_assignment_tpu.obs import roofline
+    peaks = roofline.device_peaks(device_kind)
+    r_in, r_out = resident_bytes(cfg)
+    peak = None
+    if closed is not None:
+        peak = peak_live_bytes(closed.jaxpr)
+    elif trace:
+        peak = peak_live_bytes(trace_round_body(cfg).jaxpr)
+    row = vmem_verdict(r_in, r_out, peak, grid_steps=1,
+                       vmem_bytes=peaks["vmem_bytes"])
+    row.update(kernel="deep.round_fused", device_kind=peaks["kind"],
+               basis="block-table" if peak is None else "traced-liveness")
+    return [row]
+
+
+def check_vmem(cfg: SystemConfig, device_kind: Optional[str] = None,
+               trace: bool = True, closed=None) -> dict:
+    """Pass 2: fail any kernel whose required bytes exceed the
+    device's VMEM (finding kind ``vmem_budget``)."""
+    rows = vmem_rows(cfg, device_kind=device_kind, trace=trace,
+                     closed=closed)
+    findings = [{"pass": "vmem", "kind": "vmem_budget",
+                 "detail": f"{r['kernel']}: required "
+                           f"{r['required_bytes']} B > VMEM "
+                           f"{r['vmem_bytes']} B on {r['device_kind']}"}
+                for r in rows if not r["ok"]]
+    return {"rows": rows, "findings": findings, "ok": not findings}
+
+
+# ---------------------------------------------------------------------------
+# pass 3: Mosaic lowerability
+# ---------------------------------------------------------------------------
+
+def audit_lowerability(jaxpr, findings: List[dict],
+                       target: str = "pallas_round.round_body") -> int:
+    """Walk a traced kernel body for constructs with no TPU Pallas
+    lowering; returns the flattened equation count. Finding kinds:
+    ``mosaic_lowerability`` (banned primitive), ``wide_dtype``,
+    ``dynamic_shape``, ``host_callback``."""
+    n = 0
+    stack = [jaxpr]
+    while stack:
+        j = stack.pop()
+        for eqn in j.eqns:
+            n += 1
+            name = eqn.primitive.name
+            if "callback" in name:
+                findings.append({"pass": "lowerability",
+                                 "kind": "host_callback",
+                                 "detail": f"{target}: primitive "
+                                           f"{name!r}"})
+            elif (name in _BANNED_EXACT
+                  or any(name.startswith(p) for p in _BANNED_PREFIX)):
+                findings.append({"pass": "lowerability",
+                                 "kind": "mosaic_lowerability",
+                                 "detail": f"{target}: primitive "
+                                           f"{name!r} has no TPU "
+                                           "vector lowering"})
+            nd = eqn.params.get("new_dtype")
+            if nd is not None and str(nd) in _WIDE_DTYPES:
+                findings.append({"pass": "lowerability",
+                                 "kind": "wide_dtype",
+                                 "detail": f"{target}: convert -> {nd}"})
+            for var in eqn.outvars:
+                aval = var.aval
+                dt = getattr(aval, "dtype", None)
+                if dt is not None and str(dt) in _WIDE_DTYPES:
+                    findings.append({"pass": "lowerability",
+                                     "kind": "wide_dtype",
+                                     "detail": f"{target}: {name} "
+                                               f"output {dt}"})
+                for dim in getattr(aval, "shape", ()):
+                    if not isinstance(dim, int):
+                        findings.append({"pass": "lowerability",
+                                         "kind": "dynamic_shape",
+                                         "detail": f"{target}: {name} "
+                                                   f"dim {dim!r}"})
+            for v in eqn.params.values():
+                stack.extend(_subjaxprs(v))
+    return n
+
+
+def check_lowerability(cfg: SystemConfig, closed=None) -> dict:
+    """Pass 3 over the fused body's jaxpr (retraces unless the caller
+    shares one trace across passes)."""
+    closed = trace_round_body(cfg) if closed is None else closed
+    findings: List[dict] = []
+    n = audit_lowerability(closed.jaxpr, findings)
+    return {"eqns": n, "findings": findings, "ok": not findings}
+
+
+# ---------------------------------------------------------------------------
+# pass 4: gate consistency — supported() must equal the derivation
+# ---------------------------------------------------------------------------
+
+def _probe_configs() -> list:
+    """A small config family spanning every gate edge: the headline,
+    the newly widened single-wave deep@8192, the multi-wave config the
+    widening must NOT admit, the cap boundary, a storm config and a
+    non-deep config."""
+    mk = lambda n, dd, tw, **kw: dataclasses.replace(
+        SystemConfig.scale(num_nodes=n, drain_depth=dd, txn_width=tw),
+        **{"deep_window": True, "deep_ownerval_slots": 1, **kw})
+    return [
+        ("headline_4096", mk(4096, 13, 3, deep_slots=3)),
+        ("widened_8192_q3_w1", mk(8192, 2, 2, deep_slots=3)),
+        ("multiwave_8192_q3_w2",
+         mk(8192, 2, 2, deep_slots=3, deep_waves=2)),
+        ("cap_boundary_16384", mk(16384, 2, 2, deep_slots=2)),
+        ("storm_256", mk(256, 2, 2, deep_slots=2,
+                         deep_read_storm=True, deep_ownerval_slots=2)),
+        ("xla_only_256", dataclasses.replace(
+            SystemConfig.scale(num_nodes=256, drain_depth=2,
+                               txn_width=2), deep_window=False)),
+    ]
+
+
+def analyzer_admits(cfg: SystemConfig) -> bool:
+    """The analyzer's own verdict on a config: structural gates
+    (deep-window only; no read-storm — the storm's duplicate-row
+    commits break the routed scatters' uniqueness contract, a property
+    of the engine, not of rounding) plus the derived contender cap."""
+    if not cfg.deep_window or cfg.deep_read_storm:
+        return False
+    b = derived_bounds(cfg)
+    return b["max_contenders"] < b["cap_limit"]
+
+
+def check_gates() -> dict:
+    """Pass 4: over the probe family, ``pallas_round.supported`` must
+    agree with `analyzer_admits` exactly — a gate that drifts from its
+    proof artifact (or a tampered proof) is finding
+    ``gate_divergence``. Also records the legacy product bound's
+    verdict per probe, making the widening auditable."""
+    from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_round as pr
+    findings: List[dict] = []
+    probes = {}
+    for name, cfg in _probe_configs():
+        sup = bool(pr.supported(cfg))
+        adm = analyzer_admits(cfg)
+        legacy = bool(cfg.deep_window and not cfg.deep_read_storm
+                      and cfg.deep_slots * cfg.num_nodes < (1 << 14))
+        probes[name] = {"supported": sup, "analyzer": adm,
+                        "legacy_product_bound": legacy,
+                        "widened": sup and not legacy}
+        if sup != adm:
+            findings.append({
+                "pass": "gates", "kind": "gate_divergence",
+                "detail": f"{name}: supported()={sup} but the "
+                          f"derivation says {adm}"})
+    return {"probes": probes, "findings": findings, "ok": not findings}
+
+
+# ---------------------------------------------------------------------------
+# the report
+# ---------------------------------------------------------------------------
+
+def headline_config(num_nodes: int = 4096) -> SystemConfig:
+    """The deep-engine headline config (bench.py / cmd_perfreport deep
+    defaults) the default ``analyze --kernel`` run verifies."""
+    return dataclasses.replace(
+        SystemConfig.scale(num_nodes=num_nodes, drain_depth=13,
+                           txn_width=3),
+        deep_window=True,
+        deep_slots=2 if num_nodes >= 32768 else 3,
+        deep_ownerval_slots=1, deep_horizon_slack=4)
+
+
+def check(cfg: Optional[SystemConfig] = None, trace: bool = True,
+          device_kind: Optional[str] = None) -> dict:
+    """Run all four passes; ``trace=False`` restricts to the
+    arithmetic/static passes (no jaxpr trace — the fast path mutation
+    smokes use; VMEM is then block-table-only and lowerability is
+    skipped)."""
+    cfg = headline_config() if cfg is None else cfg
+    ex = check_exactness(cfg)
+    gates = check_gates()
+    closed = trace_round_body(cfg) if trace else None
+    vm = check_vmem(cfg, device_kind=device_kind, trace=False,
+                    closed=closed)
+    low = (check_lowerability(cfg, closed) if closed is not None
+           else {"eqns": None, "findings": [], "ok": None})
+    findings = (ex["findings"] + vm["findings"] + low["findings"]
+                + gates["findings"])
+    return {"schema": SCHEMA,
+            "config": {"num_nodes": cfg.num_nodes,
+                       "deep_slots": cfg.deep_slots,
+                       "deep_waves": cfg.deep_waves,
+                       "drain_depth": cfg.drain_depth,
+                       "txn_width": cfg.txn_width},
+            "traced": bool(trace),
+            "exactness": ex, "vmem": vm, "lowerability": low,
+            "gates": gates,
+            "findings": findings, "ok": not findings}
+
+
+def render_text(rep: dict) -> list:
+    """One line per pass plus findings — the runner's print format."""
+    b = rep["exactness"]["bounds"]
+    c = rep["config"]
+    lines = [
+        f"== kernel contracts: {'ok' if rep['ok'] else 'FAIL'} "
+        f"[deep@{c['num_nodes']} q{c['deep_slots']} "
+        f"w{c['deep_waves']}; traced={rep['traced']}]",
+        f"   exactness: ladder A={b['A']} G={b['G']} "
+        f"chunk={b['chunk_bits']}b span 2**[{b['ladder_min_exp']},"
+        f"{b['ladder_max_exp']}]; cap {b['cap_limit']} "
+        f"(exact {b['cap_exact']}); contenders/entry "
+        f"{b['max_contenders']}",
+    ]
+    for r in rep["vmem"]["rows"]:
+        pk = ("-" if r["peak_bytes"] is None
+              else f"{r['peak_bytes'] / 2**20:.2f}")
+        lines.append(
+            f"   vmem[{r['kernel']}] ({r['basis']}): resident "
+            f"{(r['resident_in_bytes'] + r['resident_out_bytes']) / 2**20:.2f}"
+            f" MiB, peak {pk} MiB, budget "
+            f"{r['vmem_bytes'] / 2**20:.0f} MiB ({r['device_kind']})")
+    if rep["lowerability"]["ok"] is not None:
+        lines.append(f"   lowerability: {rep['lowerability']['eqns']} "
+                     f"flattened eqns, banned-primitive scan "
+                     f"{'clean' if rep['lowerability']['ok'] else 'FAIL'}")
+    w = [n for n, p in rep["gates"]["probes"].items() if p["widened"]]
+    lines.append(f"   gates: {len(rep['gates']['probes'])} probes, "
+                 f"widened vs legacy product bound: "
+                 f"{', '.join(w) if w else 'none'}")
+    for f in rep["findings"]:
+        lines.append(f"  ! {f['pass']}/{f['kind']}: {f['detail']}")
+    return lines

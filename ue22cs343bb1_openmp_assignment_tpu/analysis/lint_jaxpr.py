@@ -41,6 +41,14 @@ from ue22cs343bb1_openmp_assignment_tpu.state import init_state
 #: headroom for growth but catches any O(N) unrolling)
 EQN_BUDGET = 2048
 
+#: per-target overrides of EQN_BUDGET.  The fused round body is a
+#: whole deep round in one trace — drain fori_loops, the 16-way
+#: scatter-min ladder, window fold — measured ~36k flattened eqns at
+#: the N=8 probe config and nearly N-independent (the routed ops are
+#: matmuls, not unrolled loops); 65536 bounds it while still tripping
+#: on any per-node unrolling (which would multiply the count by N)
+EQN_BUDGETS = {"pallas_round.round_body": 65536}
+
 _WIDE = ("int64", "uint64", "float64")
 _HOST_PRIMS = ("infeed", "outfeed")
 
@@ -105,6 +113,7 @@ def _targets(cfg: SystemConfig) -> dict:
         "step.run_to_quiescence":
             lambda s: step.run_to_quiescence(cfg, s, 64),
         "pallas_round.routed_ops": lambda s: _routed_ops_probe(),
+        "pallas_round.round_body": lambda s: _round_body_probe(),
         "rdma_comm.route": lambda s: _rdma_route_probe(),
     }
 
@@ -135,6 +144,30 @@ def _routed_ops_probe():
             ix.scatter_rows(mat, idx, rows),
             ix.scatter_col(mat, idx, 2, rows[:, 0]),
             ix.scatter_min(dest, idx, rows[:, 0] + 41))
+
+
+def _round_body_probe():
+    """Trace the ENTIRE fused round body (ops/pallas_round._round_body
+    — the pure function `_round_kernel` wraps between its VMEM load and
+    store) at a small deep config, so the whole-kernel IR faces the
+    wide-dtype / dynamic-shape / host-callback rules and its own eqn
+    budget (EQN_BUDGETS).  This is the same trace the kernel-contract
+    verifier (analysis/kernelcheck) walks for VMEM liveness and
+    lowerability; here it rides the always-on --jaxpr prong at probe
+    size so a budget regression shows up in CI before anyone runs
+    --kernel."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_round as pr
+
+    cfg = dataclasses.replace(
+        SystemConfig.scale(num_nodes=8, drain_depth=2, txn_width=2),
+        deep_window=True, deep_slots=4, deep_ownerval_slots=2)
+    ins, _ = pr._block_shapes(cfg)
+    args = [jnp.zeros(s, jnp.int32) for s in ins]
+    return pr._round_body(cfg, *args)
 
 
 def _rdma_route_probe():
@@ -173,12 +206,14 @@ def lint(cfg: Optional[SystemConfig] = None,
     for name, fn in _targets(cfg).items():
         closed = jax.make_jaxpr(fn)(st)
         counts[name] = _audit(closed.jaxpr, name, findings)
-        if counts[name] > EQN_BUDGET:
+        budget = EQN_BUDGETS.get(name, EQN_BUDGET)
+        if counts[name] > budget:
             findings.append({
                 "target": name, "rule": "primitive_budget",
-                "detail": f"{counts[name]} eqns > budget {EQN_BUDGET}"})
+                "detail": f"{counts[name]} eqns > budget {budget}"})
     return {"schema": "cache-sim/jaxpr-lint/v1",
             "num_nodes": cfg.num_nodes, "budget": EQN_BUDGET,
+            "budget_overrides": dict(EQN_BUDGETS),
             "targets": counts, "findings": findings,
             "ok": not findings}
 

@@ -254,6 +254,89 @@ TABLE_MUTATIONS = {
 }
 
 
+# ---------------------------------------------------------------------------
+# Kernel-contract mutants: seeded bugs in the fused Pallas round's
+# arithmetic contracts, each caught *statically* by the kernel-contract
+# verifier (analysis/kernelcheck, `cache-sim analyze --kernel`) with no
+# trace and no execution — the verifier's own regression suite. Each is
+# a context manager that perturbs the real module-level parameter the
+# kernel routes with (ops/pallas_round reads these constants at trace
+# time, and kernelcheck derives its caps from the same names, so the
+# mutation hits both the kernel and its proof obligation).
+# ---------------------------------------------------------------------------
+
+import contextlib
+
+
+@contextlib.contextmanager
+def widen_min_chunk():
+    """Widen the scatter-min ladder chunk from 4 to 5 bits — "fewer
+    passes, same ladder" looks like a free optimization, but the
+    32-value ladder's lowest rung becomes 2**(100 - 15*31) = 2**-365,
+    far below f32's 2**-126 minimum normal: the deep rungs flush to
+    zero and the min-chunk readout silently loses deep contenders.
+    Expected: `ladder_range` from the exactness pass."""
+    from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_round as pr
+    old = pr._MIN_CHUNK_BITS
+    pr._MIN_CHUNK_BITS = 5
+    try:
+        yield
+    finally:
+        pr._MIN_CHUNK_BITS = old
+
+
+@contextlib.contextmanager
+def narrow_ladder_gap():
+    """Shrink the weight-exponent gap G from 15 to 11 — the ladder
+    still spans comfortably inside f32 range (tempting if someone
+    wants headroom for more chunks), but adjacent-threshold separation
+    collapses to 2**11, so the certified contender cap drops to 2**10
+    = 1024, under the headline's 4096 per-entry contenders. Expected:
+    `contender_cap` from the exactness pass."""
+    from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_round as pr
+    old = pr._MIN_G
+    pr._MIN_G = 11
+    try:
+        yield
+    finally:
+        pr._MIN_G = old
+
+
+@contextlib.contextmanager
+def lift_storm_gate():
+    """Drop the read-storm structural gate from
+    ``pallas_round.supported`` — the contender arithmetic happily
+    admits small storm configs, but duplicate-row storm commits break
+    the routed scatters' uniqueness contract, which no rounding margin
+    covers. Expected: `gate_divergence` from the gate-consistency pass
+    (supported() says yes on the storm probe; the analyzer says no)."""
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import kernelcheck
+    from ue22cs343bb1_openmp_assignment_tpu.ops import pallas_round as pr
+    old = pr.supported
+
+    def patched(cfg):
+        if not cfg.deep_window:
+            return False
+        b = kernelcheck.derived_bounds(cfg)
+        return b["max_contenders"] < b["cap_limit"]
+
+    pr.supported = patched
+    try:
+        yield
+    finally:
+        pr.supported = old
+
+
+#: name -> (context manager seeding the bug, kernelcheck finding kind
+#: the --kernel prong must raise). All killed with trace=False: the
+#: exactness/gates passes are pure arithmetic.
+KERNEL_MUTATIONS = {
+    "widen_min_chunk": (widen_min_chunk, "ladder_range"),
+    "narrow_ladder_gap": (narrow_ladder_gap, "contender_cap"),
+    "lift_storm_gate": (lift_storm_gate, "gate_divergence"),
+}
+
+
 # name -> (wrapper, scope that exposes it, finding the checker must raise)
 MUTATIONS = {
     "skip_em_bitvec_clear": (skip_em_bitvec_clear, "2n2a",

@@ -3,10 +3,13 @@
 Runs the verification prongs: the symmetry-reduced protocol model
 checker over the builtin small scopes, the linters (AST trace lint
 always; jaxpr IR lint + recompilation guard behind ``--jaxpr``), the
-coverage-guided differential fuzzer behind ``--fuzz N``, and the
+coverage-guided differential fuzzer behind ``--fuzz N``, the
 memory-consistency litmus matrix behind ``--litmus`` (exhaustive
 outcome enumeration vs the declarative allowed sets,
-analysis/litmus.py). Prints a
+analysis/litmus.py), and the kernel-contract verifier behind
+``--kernel`` (exact-arithmetic cap derivation, static VMEM footprint
+vs device budget, Mosaic-lowerability lint over the fused round body;
+analysis/kernelcheck.py). Prints a
 human report that keeps reference-sanctioned quirks (`~`) visually
 distinct from genuine violations (`!`), optionally writes the full
 JSON report, and exits by the code table in ``--help``. This is the CI
@@ -26,7 +29,8 @@ exit codes — the one canonical contract for `cache-sim analyze`:
   0  clean pass — every requested check ran to completion and passed
   1  findings — a protocol violation, lint finding, fuzz divergence,
      table-verification failure, table/handler conformance divergence,
-     or failed recompilation guard
+     kernel-contract finding (rounding lemma, VMEM budget,
+     lowerability, or gate divergence), or failed recompilation guard
   2  usage error (argparse's code, left untouched)
   3  budget exhausted, no finding — a scope hit --max-states before
      exhausting its state space: nothing failed, but nothing was
@@ -109,6 +113,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--jaxpr", action="store_true",
                    help="run the jaxpr IR lint over the ops/ hot paths "
                         "plus the three-engine recompilation guard")
+    p.add_argument("--kernel", action="store_true",
+                   help="run the kernel-contract prong: re-derive the "
+                        "fused round's contender cap from (chunk bits, "
+                        "weight exponents, f32 mantissa) with machine-"
+                        "checked rounding lemmas, trace the kernel body "
+                        "for a static VMEM footprint vs the device "
+                        "budget, lint the jaxpr for non-lowerable "
+                        "primitives, and cross-check pallas_round."
+                        "supported() against the derived bounds")
+    p.add_argument("--kernel-nodes", type=int, default=4096,
+                   metavar="N",
+                   help="node count for the kernel-contract headline "
+                        "config (default 4096, the perf-report deep "
+                        "headline)")
+    p.add_argument("--kernel-static", action="store_true",
+                   help="skip the kernel-body trace: exactness + gate "
+                        "passes and the block-table VMEM row only "
+                        "(~1s instead of ~15s; traced liveness peak "
+                        "and lowerability scan are skipped)")
     p.add_argument("--json", dest="json_path", default=None,
                    help="write the full JSON report here")
     p.add_argument("--lint-paths", nargs="*", default=None,
@@ -141,12 +164,21 @@ def _resolve_mutation(name):
             "values, so the invariant prongs cannot see it; run it "
             "through the litmus prong (--litmus --skip-model-check "
             "--skip-lint) or the fuzzer's consistency oracle")
+    if name in mutations.KERNEL_MUTATIONS:
+        raise SystemExit(
+            f"`{name}` is a kernel mutation — it perturbs the fused "
+            "Pallas round's arithmetic contracts (ladder constants / "
+            "support gates), which the protocol prongs never touch; "
+            "run it through the kernel-contract prong (--kernel "
+            "--skip-model-check --skip-lint)")
     if name not in mutations.MUTATIONS:
         raise SystemExit(
             f"unknown mutation `{name}` (handler mutations: "
             f"{', '.join(mutations.MUTATIONS)}; table mutations: "
             f"{', '.join(mutations.TABLE_MUTATIONS)}; consistency "
-            f"mutations: {', '.join(mutations.CONSISTENCY_MUTATIONS)})")
+            f"mutations: {', '.join(mutations.CONSISTENCY_MUTATIONS)}; "
+            f"kernel mutations: "
+            f"{', '.join(mutations.KERNEL_MUTATIONS)})")
     return mutations.MUTATIONS[name]
 
 
@@ -378,6 +410,52 @@ def run_table(scope_names, mutation, max_states, quiet) -> dict:
     return out
 
 
+def run_kernel(nodes, static, mutation, quiet) -> dict:
+    """The kernel-contract prong: exactness, VMEM, lowerability, and
+    gate-consistency audits of the fused Pallas round
+    (analysis/kernelcheck.py). A seeded kernel mutation forces the
+    static passes only — every kernel mutant is killed by arithmetic,
+    no trace needed — and the run must then FAIL with the documented
+    finding kind (asserted here, so a mutant the verifier misses is
+    itself a finding)."""
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import (kernelcheck,
+                                                             mutations)
+    kmut = mutations.KERNEL_MUTATIONS.get(mutation) if mutation else None
+    if mutation is not None and kmut is None and \
+            mutation not in mutations.MUTATIONS:
+        # non-kernel mutations were rejected upstream unless they are
+        # handler mutations riding along for another prong; those don't
+        # touch kernel arithmetic, so the prong just runs clean
+        raise SystemExit(
+            f"unknown mutation `{mutation}` (kernel mutations: "
+            f"{', '.join(mutations.KERNEL_MUTATIONS)})")
+
+    cfg = kernelcheck.headline_config(num_nodes=nodes)
+    trace = not static
+    if kmut is not None:
+        trace = False
+        _print(quiet, f"== seeded kernel mutation `{mutation}` "
+                      f"(expected finding: {kmut[1]})")
+        with kmut[0]():
+            rep = kernelcheck.check(cfg, trace=False)
+        kinds = [f["kind"] for f in rep["findings"]]
+        rep["expected_kind"] = kmut[1]
+        rep["mutant_killed"] = (not rep["ok"]) and kmut[1] in kinds
+        if not rep["mutant_killed"]:
+            # the verifier MISSED a seeded bug: that is the failure
+            rep["ok"] = False
+            rep["findings"].append({
+                "pass": "mutation", "kind": "mutant_survived",
+                "detail": f"seeded kernel mutation `{mutation}` was not "
+                          f"caught (expected `{kmut[1]}`, got "
+                          f"{kinds or 'no findings'})"})
+    else:
+        rep = kernelcheck.check(cfg, trace=trace)
+    for line in kernelcheck.render_text(rep):
+        _print(quiet, line)
+    return rep
+
+
 def run_fuzz(n_cases, seed, mutation, repro_dir, quiet,
              flight_dir=None) -> dict:
     from ue22cs343bb1_openmp_assignment_tpu.analysis import fuzz as fz
@@ -425,7 +503,8 @@ def main(argv=None) -> int:
         return 0
 
     report = {"model_check": {}, "lint": None, "jaxpr": None,
-              "fuzz": None, "table": None, "litmus": None}
+              "fuzz": None, "table": None, "litmus": None,
+              "kernel": None}
     ok, exhausted = True, False
     if not args.skip_model_check:
         report["model_check"] = run_model_check(
@@ -461,6 +540,11 @@ def main(argv=None) -> int:
     if args.jaxpr:
         report["jaxpr"] = run_jaxpr(args.quiet)
         ok &= report["jaxpr"]["ok"]
+    if args.kernel:
+        report["kernel"] = run_kernel(args.kernel_nodes,
+                                      args.kernel_static, args.mutation,
+                                      args.quiet)
+        ok &= report["kernel"]["ok"]
     if args.fuzz > 0:
         report["fuzz"] = run_fuzz(args.fuzz, args.seed, args.mutation,
                                   args.repro_dir, args.quiet,

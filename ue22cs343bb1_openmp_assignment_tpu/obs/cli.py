@@ -931,6 +931,15 @@ def cmd_perfreport(args) -> int:
                 fused["hbm_bytes"] * steps / retired, 6),
             "unfused_bytes_per_instr": doc["bytes_per_instr"],
         }
+    if args.engine == "deep":
+        # the fused round's VMEM budget row, from the kernel-contract
+        # verifier's static block-table accounting (deterministic shape
+        # arithmetic — the traced-liveness peak is `analyze --kernel`'s
+        # job, not the perf report's)
+        from ue22cs343bb1_openmp_assignment_tpu.analysis import (
+            kernelcheck)
+        doc["vmem"] = kernelcheck.vmem_rows(
+            cfg, device_kind=args.device_kind, trace=False)
     if args.timing:
         timer = PhaseTimer()
         rep_times = []

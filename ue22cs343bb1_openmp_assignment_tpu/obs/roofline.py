@@ -45,23 +45,35 @@ _BYTES_KEY = "bytes accessed"
 _OUT_BYTES_KEY = "bytes accessedout{}"
 
 #: static peak table: device_kind substring (lowercased, first match
-#: wins) -> nominal peak dense-compute flops/s and HBM bytes/s. These
-#: are ceilings for *classification*, not marketing claims — the bound
-#: verdict only needs the ridge point's order of magnitude. Sources:
-#: published TPU spec sheets; the cpu row is a nominal 1-core AVX box
-#: so CPU-tier smoke runs still classify.
+#: wins) -> nominal peak dense-compute flops/s, HBM bytes/s and
+#: per-core VMEM capacity. These are ceilings for *classification*,
+#: not marketing claims — the bound verdict only needs the ridge
+#: point's order of magnitude. Sources: published TPU spec sheets; the
+#: cpu row is a nominal 1-core AVX box so CPU-tier smoke runs still
+#: classify. ``vmem_bytes`` is the budget analysis/kernelcheck's
+#: static VMEM pass referees fused kernels against: ~16 MiB/core on
+#: v4/v5 parts, doubled on v6e; the cpu row carries the 16 MiB
+#: as-if-TPU budget so interpret-mode CI runs gate against the
+#: smallest real target instead of not gating at all.
 PEAKS = (
-    ("v6e", {"flops_per_s": 918e12, "hbm_bytes_per_s": 1.64e12}),
-    ("v5p", {"flops_per_s": 459e12, "hbm_bytes_per_s": 2.76e12}),
-    ("v5e", {"flops_per_s": 197e12, "hbm_bytes_per_s": 819e9}),
-    ("v5 lite", {"flops_per_s": 197e12, "hbm_bytes_per_s": 819e9}),
-    ("v4", {"flops_per_s": 275e12, "hbm_bytes_per_s": 1.2e12}),
-    ("cpu", {"flops_per_s": 1e11, "hbm_bytes_per_s": 4e10}),
+    ("v6e", {"flops_per_s": 918e12, "hbm_bytes_per_s": 1.64e12,
+             "vmem_bytes": 32 * 2**20}),
+    ("v5p", {"flops_per_s": 459e12, "hbm_bytes_per_s": 2.76e12,
+             "vmem_bytes": 16 * 2**20}),
+    ("v5e", {"flops_per_s": 197e12, "hbm_bytes_per_s": 819e9,
+             "vmem_bytes": 16 * 2**20}),
+    ("v5 lite", {"flops_per_s": 197e12, "hbm_bytes_per_s": 819e9,
+                 "vmem_bytes": 16 * 2**20}),
+    ("v4", {"flops_per_s": 275e12, "hbm_bytes_per_s": 1.2e12,
+            "vmem_bytes": 16 * 2**20}),
+    ("cpu", {"flops_per_s": 1e11, "hbm_bytes_per_s": 4e10,
+             "vmem_bytes": 16 * 2**20}),
 )
 
 #: unknown device kinds classify against this generic accelerator
 #: ceiling rather than failing — the report must degrade, not die
-_FALLBACK_PEAKS = {"flops_per_s": 2e14, "hbm_bytes_per_s": 1e12}
+_FALLBACK_PEAKS = {"flops_per_s": 2e14, "hbm_bytes_per_s": 1e12,
+                   "vmem_bytes": 16 * 2**20}
 
 
 # lint: host
@@ -80,7 +92,7 @@ def detect_device_kind() -> str:
 def device_peaks(kind: Optional[str] = None) -> dict:
     """Peak specs for a device kind from the static table.
 
-    Returns ``{"kind", "flops_per_s", "hbm_bytes_per_s",
+    Returns ``{"kind", "flops_per_s", "hbm_bytes_per_s", "vmem_bytes",
     "ridge_flops_per_byte", "source"}`` — ``source`` is
     ``"static_table"`` on a match, ``"generic_fallback"`` otherwise.
     """
@@ -95,6 +107,7 @@ def device_peaks(kind: Optional[str] = None) -> dict:
     return {"kind": kind,
             "flops_per_s": peaks["flops_per_s"],
             "hbm_bytes_per_s": peaks["hbm_bytes_per_s"],
+            "vmem_bytes": peaks["vmem_bytes"],
             "ridge_flops_per_byte": (peaks["flops_per_s"]
                                      / peaks["hbm_bytes_per_s"]),
             "source": source}
@@ -431,6 +444,18 @@ def render_text(doc: dict) -> str:
             f"{f['bytes_per_instr']:.2f} vs xla-cost-model "
             f"{f['unfused_bytes_per_instr']:.2f} "
             f"({ratio:,.0f}x less HBM traffic)")
+    vm = doc.get("vmem")
+    if vm:
+        lines.append("")
+        for r in vm:
+            verdict = "fits" if r["ok"] else "OVER BUDGET"
+            lines.append(
+                f"  vmem[{r['kernel']}] ({r['basis']}): resident "
+                f"{(r['resident_in_bytes'] + r['resident_out_bytes']) / 2**20:.2f}"
+                f" MiB + headroom {r['headroom_bytes'] / 2**20:.2f} MiB"
+                f" = required {r['required_bytes'] / 2**20:.2f} MiB vs "
+                f"{r['vmem_bytes'] / 2**20:.0f} MiB VMEM "
+                f"({r['device_kind']}): {verdict}")
     tr = doc.get("transport")
     if tr:
         per = tr["bytes_per_round"]

@@ -51,18 +51,24 @@ from ue22cs343bb1_openmp_assignment_tpu.ops.sync_engine import (
 
 def _run_fold(cfg: SystemConfig, T: int, ca_ref, cv_ref, cs_ref,
               dms_ref, dmc_ref, dmo_ref, dmm_ref, woa_ref, wval_ref,
-              wlive_ref, hor_ref, bad_refs, ocode_ref):
+              wlive_ref, hor_ref, bad_refs, ocode_ref, pid=None):
     """Trace the W-step deep fold on [1, T] lane rows; returns the
     final carry (deep_fold.fold_step contract).
 
     The instruction window arrives as [W, T] blocks (built in XLA —
     procedural hash or stored-trace gather, exactly as the XLA path
     builds it), so the unrolled loop reads each step with a *static*
-    row index and the kernel works for every workload kind."""
+    row index and the kernel works for every workload kind.
+
+    ``pid`` overrides the grid coordinate (default: the pallas program
+    id). The fused round body runs at grid (1,) and passes 0, which
+    keeps it traceable outside a kernel context — that is how
+    analysis/kernelcheck audits it statically."""
     C, S = cfg.cache_size, 1 << cfg.block_bits
     Q = cfg.deep_slots
     W = cfg.drain_depth + cfg.txn_width
-    pid = pl.program_id(0)
+    if pid is None:
+        pid = pl.program_id(0)
     node = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1) + pid * T
     zero = jnp.zeros((1, T), jnp.int32)
     false = jnp.zeros((1, T), bool)

@@ -96,11 +96,17 @@ from ue22cs343bb1_openmp_assignment_tpu.ops.sync_engine import (
     DM_COLS, DM_COUNT, DM_MEM, DM_OWNER, DM_STATE, SyncState,
     claim_max_rounds, slot_bits)
 
-# chunked scatter-min weight ladder: contenders route 2**(A - G*chunk);
-# G=15 leaves a 2**14 contender/rounding margin between adjacent
-# chunk thresholds and the 16-step ladder spans [2**-125, 2**100],
-# inside f32 normal range (module docstring)
+# chunked scatter-min weight ladder: contenders route 2**(A - G*chunk)
+# over _MIN_CHUNK_BITS-wide chunks; G=15 leaves a 2**14
+# contender/rounding margin between adjacent chunk thresholds and the
+# 16-step ladder spans [2**-125, 2**100], inside f32 normal range.
+# These three are THE ladder parameters analysis/kernelcheck audits:
+# the derived contender cap, the f32 range lemmas and the supported()
+# gate are all functions of (A, G, chunk bits, f32 mantissa width), so
+# perturbing any of them (analysis/mutations.KERNEL_MUTATIONS) must
+# trip the static verifier.
 _MIN_A, _MIN_G = 100, 15
+_MIN_CHUNK_BITS = 4
 
 _HI = jax.lax.Precision.HIGHEST
 
@@ -178,17 +184,19 @@ def _route_min(idx, low, in_mask, M, L):
     chunked exponent ladder (module docstring). idx [R] int32 (any
     value outside [0, M) is dropped), low [R] the masked key low bits.
     Returns (has [M] bool, min_low [M] int32)."""
-    nch = max(1, (L + 3) // 4)
+    cb = _MIN_CHUNK_BITS
+    nvals = 1 << cb
+    nch = max(1, -(-L // cb))
     still = in_mask
     min_low = jnp.zeros((M,), jnp.int32)
     has = None
     TJ = _tile_of(M)
     flat = idx.reshape(1, -1)                                # [1, R]
     for c in range(nch):
-        sh = 4 * (nch - 1 - c)
-        chunk = (low >> sh) & 15                             # [R]
+        sh = cb * (nch - 1 - c)
+        chunk = (low >> sh) & (nvals - 1)                    # [R]
         w = jnp.zeros(idx.shape, jnp.float32)
-        for v in range(16):
+        for v in range(nvals):
             w = jnp.where(chunk == v,
                           jnp.float32(2.0 ** (_MIN_A - _MIN_G * v)), w)
         w = jnp.where(still, w, 0.0)[:, None]                # [R, 1]
@@ -206,12 +214,12 @@ def _route_min(idx, low, in_mask, M, L):
         if has is None:
             has = ssum > 0.0
         cstar = jnp.zeros((M,), jnp.int32)
-        for v in range(16):
+        for v in range(nvals):
             cstar = cstar + (
                 ssum < jnp.float32(2.0 ** (_MIN_A - _MIN_G * v))
             ).astype(jnp.int32)
-        cstar = jnp.minimum(cstar, 15)                # no-contender: 16
-        min_low = (min_low << 4) | jnp.where(has, cstar, 0)
+        cstar = jnp.minimum(cstar, nvals - 1)      # no-contender: nvals
+        min_low = (min_low << cb) | jnp.where(has, cstar, 0)
         if c < nch - 1:
             back = _route_gather(cstar[:, None], idx)[:, 0]
             still = still & (chunk == back)
@@ -268,13 +276,23 @@ def supported(cfg: SystemConfig) -> bool:
     """Can the fused round kernel run this config bit-identically?
 
     Storm configs are out (duplicate-row commits break the routed
-    scatter uniqueness contract) and deep_slots * num_nodes must stay
-    under the chunked scatter-min's 2**14 contender/rounding margin.
-    Everything else — workload kind, waves, flag mode, protocol
+    scatter uniqueness contract — a structural gate, not a margin) and
+    the per-entry scatter-min contender count must stay under the
+    chunked ladder's derived rounding cap. Both caps are COMPUTED by
+    analysis/kernelcheck (the static kernel-contract verifier), not
+    hand-derived here: the cap limit 2**(G-1) falls out of (chunk
+    bits, weight-exponent gap G, f32 mantissa width), and the
+    contender bound is N per entry at deep_waves == 1 (the window's
+    dup stop admits one same-entry event per node, ops/deep_fold) vs
+    N * deep_slots with absorption waves — which WIDENS the old
+    hand-proved `deep_slots * num_nodes < 2**14` gate for single-wave
+    configs. Everything else — workload kind, flag mode, protocol
     variant — is in scope."""
-    return (cfg.deep_window
-            and not cfg.deep_read_storm
-            and cfg.deep_slots * cfg.num_nodes < (1 << 14))
+    if not cfg.deep_window or cfg.deep_read_storm:
+        return False
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import kernelcheck
+    b = kernelcheck.derived_bounds(cfg)
+    return b["max_contenders"] < b["cap_limit"]
 
 
 def io_contract_bytes(cfg: SystemConfig) -> tuple:
@@ -290,29 +308,40 @@ def io_contract_bytes(cfg: SystemConfig) -> tuple:
     return 4 * elems_in, 4 * elems_out
 
 
-def _round_kernel(cfg: SystemConfig, params_ref, dm_ref, ca_ref,
-                  cv_ref, cs_ref, woa_ref, wval_ref, wlive_ref,
-                  hor_ref, dm_out_ref, cache_out_ref, nret_ref,
-                  delta_ref):
-    """The whole round, one kernel instance: three in-kernel folds
-    (pallas_deep._run_fold on VMEM arrays) around the shared
-    deep_round_core middle with routed index ops. State never leaves
-    VMEM between the folds and the fan-out."""
+def _block_shapes(cfg: SystemConfig) -> tuple:
+    """((in rows, cols)..., (out rows, cols)...) of the fused-round
+    pallas_call blocks, all int32 — the single source of truth shared
+    by `_call_round`'s BlockSpecs and analysis/kernelcheck's static
+    VMEM-resident accounting (9 inputs, then 4 outputs)."""
     N, C, S = cfg.num_nodes, cfg.cache_size, 1 << cfg.block_bits
-    round_ = params_ref[0, 0]
-    seed = params_ref[1, 0]
-    dm0 = dm_ref[...]
+    E = N * S
+    W = cfg.drain_depth + cfg.txn_width
+    ins = ((2, N), (E, DM_COLS), (C, N), (C, N), (C, N), (W, N),
+           (W, N), (W, N), (1, N))
+    outs = ((E, DM_COLS), (3 * C, N), (1, N), (10, N))
+    return ins, outs
+
+
+def _round_body(cfg: SystemConfig, params, dm0, ca_t, cv_t, cs_t,
+                w_oa, w_val, w_live, hor):
+    """The whole round on plain arrays: three in-kernel folds
+    (pallas_deep._run_fold — ref-style slicing works on plain arrays)
+    around the shared deep_round_core middle with routed index ops.
+    `_round_kernel` wraps this between one VMEM load and one store;
+    analysis/kernelcheck traces THIS function (jax.make_jaxpr) for the
+    static VMEM-liveness and Mosaic-lowerability passes, so what the
+    analyzer audits is the code object the kernel runs."""
+    N, C, S = cfg.num_nodes, cfg.cache_size, 1 << cfg.block_bits
+    round_ = params[0, 0]
+    seed = params[1, 0]
     dm_own = dm0.reshape(N, S, DM_COLS)
     dm_t4 = tuple(dm_own[:, :, col].T
                   for col in (DM_STATE, DM_COUNT, DM_OWNER, DM_MEM))
-    ca_t, cv_t, cs_t = ca_ref[...], cv_ref[...], cs_ref[...]
-    w_oa, w_val = woa_ref[...], wval_ref[...]
-    w_live, hor = wlive_ref[...], hor_ref[...]
 
     def fold(bad, ocode):
         return _run_fold(cfg, N, ca_t, cv_t, cs_t, dm_t4[0], dm_t4[1],
                          dm_t4[2], dm_t4[3], w_oa, w_val, w_live, hor,
-                         bad, ocode)
+                         bad, ocode, pid=0)
 
     cb = lambda rows: jnp.concatenate(rows, axis=0)
 
@@ -345,30 +374,37 @@ def _round_kernel(cfg: SystemConfig, params_ref, dm_ref, ca_ref,
     core = deep_engine.deep_round_core(
         cfg, dm0, round_, seed, pre, fold_flags_fn, fold_replay_fn,
         RoutedIndexOps(cfg, round_))
-    dm_out_ref[...] = core["dm"]
-    cache_out_ref[...] = jnp.concatenate(
+    cache_out = jnp.concatenate(
         [core["ca_c"], core["cv_c"], core["cs_c"]], axis=0)
-    nret_ref[...] = core["rp"]["n_ret"][None, :]
-    delta_ref[...] = core["delta_rows"]
+    return (core["dm"], cache_out, core["rp"]["n_ret"][None, :],
+            core["delta_rows"])
+
+
+def _round_kernel(cfg: SystemConfig, params_ref, dm_ref, ca_ref,
+                  cv_ref, cs_ref, woa_ref, wval_ref, wlive_ref,
+                  hor_ref, dm_out_ref, cache_out_ref, nret_ref,
+                  delta_ref):
+    """One VMEM load, `_round_body`, one VMEM store."""
+    dm_out, cache_out, nret, delta = _round_body(
+        cfg, params_ref[...], dm_ref[...], ca_ref[...], cv_ref[...],
+        cs_ref[...], woa_ref[...], wval_ref[...], wlive_ref[...],
+        hor_ref[...])
+    dm_out_ref[...] = dm_out
+    cache_out_ref[...] = cache_out
+    nret_ref[...] = nret
+    delta_ref[...] = delta
 
 
 def _call_round(cfg, params, dm, ca_t, cv_t, cs_t, w_oa, w_val,
                 w_live, hor2):
-    N, C, S = cfg.num_nodes, cfg.cache_size, 1 << cfg.block_bits
-    E = N * S
-    W = cfg.drain_depth + cfg.txn_width
-    blk = lambda r, c: pl.BlockSpec((r, c), lambda i: (0, 0))
-    shp = lambda r, c: jax.ShapeDtypeStruct((r, c), jnp.int32)
+    ins, outs = _block_shapes(cfg)
+    blk = lambda s: pl.BlockSpec(s, lambda i: (0, 0))
     return pl.pallas_call(
         functools.partial(_round_kernel, cfg),
         grid=(1,),
-        in_specs=[blk(2, N), blk(E, DM_COLS), blk(C, N), blk(C, N),
-                  blk(C, N), blk(W, N), blk(W, N), blk(W, N),
-                  blk(1, N)],
-        out_specs=[blk(E, DM_COLS), blk(3 * C, N), blk(1, N),
-                   blk(10, N)],
-        out_shape=[shp(E, DM_COLS), shp(3 * C, N), shp(1, N),
-                   shp(10, N)],
+        in_specs=[blk(s) for s in ins],
+        out_specs=[blk(s) for s in outs],
+        out_shape=[jax.ShapeDtypeStruct(s, jnp.int32) for s in outs],
         interpret=_interpret(),
     )(params, dm, ca_t, cv_t, cs_t, w_oa, w_val, w_live, hor2)
 
