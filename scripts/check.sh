@@ -335,6 +335,57 @@ print(f"daemon smoke: ok ({st['jobs']['done']} jobs over the socket "
 PY
 rm -rf "$DAEMON_DIR"
 
+# Record/replay smoke (each step 30s-boxed): the capture/replay plane
+# end to end over a real socket. Start a virtual-clock daemon with
+# `--record`, serve three mixed-lane jobs, shut down cleanly, then
+# re-drive the captured recording through `cache-sim replay --out`
+# (exit 0: every replayed dump digest must match its recorded one) and
+# let `bench-diff --latency` adjudicate the emitted recorded/replayed
+# entry pair — virtual-clock captures replay bit-faithfully, so any
+# verdict but exit 0 is a determinism regression (PERF.md round 17).
+REC_DIR="$(mktemp -d)"
+RSOCK="$REC_DIR/daemon.sock"
+python -m ue22cs343bb1_openmp_assignment_tpu.cli daemon \
+    --addr "$RSOCK" --slots 2 --chunk 8 --virtual-clock \
+    --record "$REC_DIR/rec" --quiet &
+RPID=$!
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.cli \
+    submit --addr "$RSOCK" --wait-up 25 --wait --timeout 25 \
+    --job '{"name":"rec0","workload":"uniform","nodes":2,"trace_len":4,"lane":"interactive"}' \
+    --job '{"name":"rec1","workload":"hotspot","nodes":2,"trace_len":4,"lane":"batch"}' \
+    --job '{"name":"rec2","workload":"zipf_hotspot","nodes":2,"trace_len":4,"lane":"batch"}'
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.cli \
+    submit --addr "$RSOCK" --drain --shutdown > /dev/null
+for _ in $(seq 1 60); do
+    kill -0 "$RPID" 2>/dev/null || break
+    sleep 0.5
+done
+if kill -0 "$RPID" 2>/dev/null; then
+    echo "record smoke FAILED: daemon still running after shutdown" >&2
+    kill -9 "$RPID"
+    exit 1
+fi
+wait "$RPID" || true
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.cli \
+    replay "$REC_DIR/rec" --out "$REC_DIR/replay"
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.cli \
+    bench-diff --latency --min-effect 50 \
+    "$REC_DIR/replay/recorded.entry.json" \
+    "$REC_DIR/replay/replayed.entry.json"
+python - "$REC_DIR" <<'PY'
+import json, pathlib, sys
+from ue22cs343bb1_openmp_assignment_tpu.obs import recording
+d = pathlib.Path(sys.argv[1])
+rec = recording.load(d / "rec")
+assert rec["clock"] == "virtual", rec["clock"]
+doc = json.loads((d / "replay" / "replay.json").read_text())
+assert doc["digests_matched"] == doc["jobs_total"] == 3, doc
+print(f"record/replay smoke: ok ({doc['jobs_total']} jobs captured "
+      f"over the socket, all digests matched on replay, "
+      f"recorded-vs-replayed latency verdict pass)")
+PY
+rm -rf "$REC_DIR"
+
 # RDMA-transport smoke (30s box): on 8 virtual CPU devices the Pallas
 # remote-DMA ring router (interpret mode — the CPU CI correctness
 # contract, parallel/rdma_comm) must bucket and exchange lanes

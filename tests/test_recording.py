@@ -1,0 +1,398 @@
+"""Traffic recording + universal replay: the capture/replay plane.
+
+The load-bearing gates:
+
+- **Byte-determinism**: the same VirtualClock session writes the same
+  ``cache-sim/recording/v1`` bytes, twice — every row is a pure
+  function of the schedule and the injected clock.
+- **Digest-before-eviction**: result digests land in the recording
+  BEFORE ``retain_results`` eviction, so the digest column is complete
+  even for jobs whose result docs the daemon already dropped.
+- **The e2e demo** (ISSUE acceptance): a virtual-clock session is
+  recorded, replayed through ``cache-sim replay`` with the ORIGINAL
+  arrival times, per-job dumps come back byte-identical (digest
+  audit), and ``bench-diff --latency`` over the emitted recorded /
+  replayed entries exits 0.
+- **Auto-detection**: the one front door classifies a recording, a
+  soak-incident dir, a flight-incident dir, a repro fixture — and
+  rejects garbage with a clear error.
+- **Shrink**: ddmin over the JOB LIST converges a seeded SLO breach to
+  <= 3 jobs that still breach on replay of the emitted incident
+  fixture.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from ue22cs343bb1_openmp_assignment_tpu import replay, soak
+from ue22cs343bb1_openmp_assignment_tpu.daemon.core import (
+    DaemonCore, attach_recorder, drive)
+from ue22cs343bb1_openmp_assignment_tpu.daemon.server import DaemonServer
+from ue22cs343bb1_openmp_assignment_tpu.obs import recording
+from ue22cs343bb1_openmp_assignment_tpu.obs.clock import VirtualClock
+from ue22cs343bb1_openmp_assignment_tpu.serve import JobSpec
+
+
+def _arrivals(n=8, rate=60.0, nodes=2, trace_len=4, seed=2):
+    arr = soak.soak_stream(rate, max(0.05, n / rate), nodes=nodes,
+                           trace_len=trace_len, seed=seed)[:n]
+    return [(t, s, ("interactive", "batch")[i % 2])
+            for i, (t, s) in enumerate(arr)]
+
+
+def _record(path, arrivals, wave_s=1e-3, **core_kw):
+    core_kw.setdefault("slots", 2)
+    core_kw.setdefault("chunk", 16)
+    core = DaemonCore(clock=VirtualClock(wave_s=wave_s), **core_kw)
+    attach_recorder(core, str(path))
+    drive(core, arrivals)
+    core.recorder.close()
+    return core
+
+
+# -- the artifact ----------------------------------------------------------
+
+
+def test_recording_byte_determinism_virtual_clock(tmp_path):
+    """Two fresh VirtualClock sessions over the same schedule write
+    byte-identical recordings (the capture analogue of the daemon's
+    trace/stats determinism gate)."""
+    arrivals = _arrivals(8)
+    c1 = _record(tmp_path / "a", arrivals)
+    c2 = _record(tmp_path / "b", arrivals)
+    b1 = (tmp_path / "a" / recording.FILENAME).read_bytes()
+    b2 = (tmp_path / "b" / recording.FILENAME).read_bytes()
+    assert b1 == b2
+    assert c1.recorder.submits == c2.recorder.submits == len(arrivals)
+    assert c1.recorder.results == len(arrivals)
+    rec = recording.load(tmp_path / "a")
+    assert rec["clock"] == "virtual"
+    assert rec["config"]["slots"] == 2
+    # submit rows carry the full spec and scheduled arrival offsets
+    sched = recording.arrivals(rec)
+    assert [(s.name, lane) for _, s, lane in sched] == \
+        [(s.name, lane) for _, s, lane in
+         sorted(arrivals, key=lambda a: (a[0], a[1].name))]
+    assert all(isinstance(s, JobSpec) for _, s, _ in sched)
+
+
+def test_recording_stats_block_and_validation(tmp_path):
+    """stats() exposes live capture counters; the loader rejects
+    structurally broken artifacts with named violations."""
+    arrivals = _arrivals(4)
+    core = _record(tmp_path / "r", arrivals)
+    st = core.stats()
+    assert st["recording"]["submits"] == 4
+    assert st["recording"]["results"] == 4
+    assert st["recording"]["path"].endswith(recording.FILENAME)
+    # no recorder -> null block, still schema-valid
+    bare = DaemonCore(slots=2, clock=VirtualClock())
+    assert bare.stats()["recording"] is None
+
+    path = tmp_path / "r" / recording.FILENAME
+    rows = [json.loads(x) for x in path.read_text().splitlines()]
+    with pytest.raises(ValueError, match="schema"):
+        recording.validate({**rows[0], "schema": "nope"}, rows[1:])
+    with pytest.raises(ValueError, match="no prior submit"):
+        recording.validate(rows[0], [r for r in rows[1:]
+                                     if r["event"] == "result"])
+    dup = [r for r in rows[1:] if r["event"] == "submit"][:1] * 2
+    with pytest.raises(ValueError, match="duplicate submit"):
+        recording.validate(rows[0], dup)
+
+
+def test_digest_recorded_before_retention_eviction(tmp_path):
+    """The satellite fix: with retain_results far below the job count,
+    evicted jobs answer 'unknown' over the wire but their digests are
+    complete in the recording (computed in _extract BEFORE
+    _retire)."""
+    arrivals = _arrivals(8)
+    core = _record(tmp_path / "r", arrivals, retain_results=2,
+                   keep_dumps=False)
+    assert core.results_evicted > 0
+    rec = recording.load(tmp_path / "r")
+    results = recording.results_by_job(rec)
+    assert len(results) == len(arrivals)
+    assert all(r["digest"] and r["digest"] != "None"
+               for r in results.values())
+    # the evicted jobs really are gone from the daemon's memory
+    assert len(core.results) <= 2
+
+
+def test_subset_and_slice_window():
+    rec = {"schema": recording.SCHEMA_ID, "clock": "virtual",
+           "config": {},
+           "rows": [
+               {"event": "submit", "job": "a", "lane": "batch",
+                "t_s": 0.0, "depth": 1, "spec": {"name": "a"}},
+               {"event": "submit", "job": "b", "lane": "batch",
+                "t_s": 1.0, "depth": 2, "spec": {"name": "b"}},
+               {"event": "result", "job": "a", "t_s": 1.5,
+                "quiesced": True, "digest": "x", "cycles": 3,
+                "bucket": "mesi:2x4"},
+               {"event": "submit", "job": "c", "lane": "batch",
+                "t_s": 2.0, "depth": 1, "spec": {"name": "c"}},
+           ]}
+    sub = recording.subset(rec, {"b"})
+    assert [r["job"] for r in sub["rows"]] == ["b"]
+    # slice keeps jobs SUBMITTED in-window; result rows ride along
+    win = recording.slice_window(rec, 0.0, 1.0)
+    assert {r["job"] for r in win["rows"]} == {"a", "b"}
+    assert any(r["event"] == "result" for r in win["rows"])
+    assert recording.derived_arrival_rate(rec) == pytest.approx(1.5)
+
+
+# -- universal replay ------------------------------------------------------
+
+
+def test_replay_detect_matrix(tmp_path):
+    """One front door, four artifact kinds, and a clear refusal."""
+    arrivals = _arrivals(3)
+    _record(tmp_path / "rec", arrivals)
+    assert replay.detect(tmp_path / "rec") == "recording"
+    assert replay.detect(
+        tmp_path / "rec" / recording.FILENAME) == "recording"
+
+    soak_inc = tmp_path / "soak_inc"
+    soak_inc.mkdir()
+    (soak_inc / "incident.json").write_text(json.dumps(
+        {"schema": soak.INCIDENT_SCHEMA_ID}))
+    assert replay.detect(soak_inc) == "soak-incident"
+
+    flight_inc = tmp_path / "flight_inc"
+    flight_inc.mkdir()
+    (flight_inc / "incident.json").write_text(json.dumps(
+        {"schema": "cache-sim/incident/v1"}))
+    assert replay.detect(flight_inc) == "flight-incident"
+
+    fix = tmp_path / "fix"
+    fix.mkdir()
+    (fix / "repro.json").write_text(json.dumps(
+        {"schema": "cache-sim/repro/v1"}))
+    assert replay.detect(fix) == "fixture"
+    assert replay.detect(fix / "repro.json") == "fixture"
+
+    garbage = tmp_path / "garbage.jsonl"
+    garbage.write_text("not json at all\n")
+    with pytest.raises(ValueError, match="not a replayable artifact"):
+        replay.detect(garbage)
+    with pytest.raises(ValueError, match="not a replayable artifact"):
+        replay.detect(tmp_path / "does_not_exist")
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(ValueError, match="not a replayable artifact"):
+        replay.detect(empty)
+    # the CLI maps the refusal to exit 2, not a traceback
+    assert replay.main([str(garbage)]) == 2
+
+
+def test_record_replay_e2e_demo(tmp_path, capsys):
+    """ISSUE acceptance, pinned: record a virtual-clock session,
+    replay it via `cache-sim replay` with original arrival times, all
+    per-job dumps byte-identical (digest audit), and bench-diff
+    --latency over the emitted entry pair exits 0."""
+    from ue22cs343bb1_openmp_assignment_tpu.obs import cli as obs_cli
+    arrivals = _arrivals(10)
+    _record(tmp_path / "rec", arrivals)
+    out = tmp_path / "out"
+    rc = replay.main([str(tmp_path / "rec"), "--out", str(out)])
+    assert rc == 0
+    doc = json.loads((out / "replay.json").read_text())
+    assert doc["transport"] == "replay"
+    assert doc["jobs_total"] == doc["jobs_quiesced"] == len(arrivals)
+    assert doc["digests_matched"] == len(arrivals)
+    assert doc["digest_mismatches"] == []
+    # the deterministic in-proc replay reproduces the RECORDED latency
+    # distribution exactly (same clock, same schedule, same machine)
+    assert doc["latency"]["samples_ms"] == \
+        doc["recorded_latency"]["samples_ms"]
+    assert doc["latency_verdict"]["verdict"] != "incomparable"
+    rc2 = obs_cli.main_bench_diff(
+        ["--latency", str(out / "recorded.entry.json"),
+         str(out / "replayed.entry.json")])
+    assert rc2 == 0
+    capsys.readouterr()
+
+
+def test_replay_flags_divergent_dumps(tmp_path):
+    """A replay under a DIFFERENT scheduler shape may still quiesce —
+    but if any dump digest drifts, the replay exits 1 and names the
+    jobs. Tampering with a recorded digest is the cheap way to force
+    the path."""
+    arrivals = _arrivals(4)
+    _record(tmp_path / "rec", arrivals)
+    path = tmp_path / "rec" / recording.FILENAME
+    lines = path.read_text().splitlines()
+    out = []
+    for ln in lines:
+        row = json.loads(ln)
+        if row.get("event") == "result":
+            row["digest"] = "0" * 16
+        out.append(json.dumps(row, sort_keys=True))
+    path.write_text("\n".join(out) + "\n")
+    rc = replay.main([str(tmp_path / "rec")])
+    assert rc == 1
+
+
+def test_replay_through_live_daemon_round_trip(tmp_path):
+    """Tentpole (b) over a real socket: a daemon in record mode
+    captures client traffic; the recording then replays and the
+    recorded/replayed latency entries are comparable (same metric,
+    same derived arrival rate — never 'incomparable')."""
+    from ue22cs343bb1_openmp_assignment_tpu.obs import regress
+    core = DaemonCore(slots=2, chunk=8)
+    attach_recorder(core, str(tmp_path / "cap"))
+    server = DaemonServer(core, str(tmp_path / "daemon.sock"),
+                          quiet=True)
+    th = threading.Thread(target=server.run, daemon=True)
+    th.start()
+    try:
+        arrivals = soak.soak_stream(40.0, 0.15, nodes=2, trace_len=4,
+                                    seed=7)
+        soak.soak_daemon(arrivals, str(tmp_path / "daemon.sock"),
+                         arrival_rate=40.0)
+    finally:
+        server.stop()
+        th.join(10.0)
+    core.recorder.close()
+    rec = recording.load(tmp_path / "cap")
+    assert rec["clock"] == "monotonic"
+    assert len(recording.arrivals(rec)) == len(arrivals)
+    # original lanes are preserved row by row
+    lanes = [lane for _, _, lane in recording.arrivals(rec)]
+    assert set(lanes) == {"interactive", "batch"}
+    doc = replay.replay_recording(rec)
+    assert doc["jobs_quiesced"] == doc["jobs_total"] == len(arrivals)
+    assert doc["digests_matched"] == len(arrivals)
+    a, b = replay.latency_entries(rec, doc)
+    rep = regress.compare_latency(a, b)
+    assert rep["verdict"] != "incomparable"
+    assert a["latency"]["arrival_rate"] == b["latency"]["arrival_rate"]
+
+
+def test_slo_breach_incident_embeds_breach_window_slice(tmp_path):
+    """Tentpole (a): an SLO breach on replay dumps an incident dir
+    whose embedded recording slice is itself a replayable artifact."""
+    arrivals = [(t, s, "batch") for t, s, _ in _arrivals(6)]
+    _record(tmp_path / "rec", arrivals, wave_s=0.05)
+    inc = tmp_path / "inc"
+    rc = replay.main([str(tmp_path / "rec"), "--wave-s", "0.05",
+                      "--slo", "p95=1",
+                      "--incident-dir", str(inc)])
+    assert rc == soak.EXIT_SLO_BREACH
+    doc = soak.load_incident(str(inc))
+    assert recording.FILENAME in doc["files"]
+    slice_rec = recording.load(inc)
+    assert len(recording.arrivals(slice_rec)) >= 1
+    assert replay.detect(inc) == "soak-incident"
+    # the incident dir replays through the same front door
+    rc2 = replay.main([str(inc), "--wave-s", "0.05"])
+    assert rc2 == 0
+
+
+def test_shrink_converges_to_minimal_breaching_subset(tmp_path):
+    """Satellite + acceptance: ddmin over the JOB LIST shrinks a
+    seeded breach to <= 3 jobs, written as an incident fixture that
+    still breaches when replayed."""
+    arrivals = [(t, s, "batch") for t, s, _ in _arrivals(6)]
+    _record(tmp_path / "rec", arrivals, wave_s=0.05)
+    shr = tmp_path / "shrunk"
+    rc = replay.main([str(tmp_path / "rec"), "--wave-s", "0.05",
+                      "--slo", "p95=1",
+                      "--incident-dir", str(tmp_path / "inc"),
+                      "--shrink", "--shrink-out", str(shr)])
+    assert rc == soak.EXIT_SLO_BREACH
+    shrunk = recording.load(shr)
+    jobs = {r["job"] for r in shrunk["rows"]
+            if r["event"] == "submit"}
+    assert 1 <= len(jobs) <= 3
+    rc2 = replay.main([str(shr), "--wave-s", "0.05", "--slo", "p95=1",
+                       "--incident-dir", str(tmp_path / "inc2")])
+    assert rc2 == soak.EXIT_SLO_BREACH
+
+
+def test_shrink_recording_predicate_memoized():
+    """shrink_recording is 1-minimal and replays each distinct subset
+    once (the predicate cache)."""
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import shrink
+    rows = []
+    for i, name in enumerate("abcd"):
+        rows.append({"event": "submit", "job": name, "lane": "batch",
+                     "t_s": float(i), "depth": 1,
+                     "spec": {"name": name}})
+    rec = {"schema": recording.SCHEMA_ID, "clock": "virtual",
+           "config": {}, "rows": rows}
+    calls = []
+
+    def pred(sub):
+        names = {r["job"] for r in sub["rows"]}
+        calls.append(names)
+        return "c" in names
+
+    small, n = shrink.shrink_recording(rec, pred)
+    assert {r["job"] for r in small["rows"]} == {"c"}
+    assert n == len(calls) == len({frozenset(c) for c in calls})
+    with pytest.raises(ValueError, match="does not hold"):
+        shrink.shrink_recording(rec, lambda sub: False)
+
+
+# -- heavy-tail load generators --------------------------------------------
+
+
+def test_bursty_stream_deterministic_and_bursty():
+    a = soak.bursty_stream(20.0, 2.0, seed=4)
+    b = soak.bursty_stream(20.0, 2.0, seed=4)
+    assert [(t, s.name) for t, s in a] == [(t, s.name) for t, s in b]
+    assert [(t, s.name) for t, s in a] != \
+        [(t, s.name) for t, s in soak.bursty_stream(20.0, 2.0, seed=5)]
+    ts = [t for t, _ in a]
+    assert ts == sorted(ts) and all(0 <= t < 2.0 for t in ts)
+    # on/off structure: the largest inter-arrival gap (an OFF window)
+    # dwarfs the in-burst median gap
+    gaps = [y - x for x, y in zip(ts, ts[1:])]
+    gaps.sort()
+    assert gaps[-1] > 4 * gaps[len(gaps) // 2]
+    with pytest.raises(ValueError, match="peak_factor"):
+        soak.bursty_stream(20.0, 1.0, peak_factor=0)
+    with pytest.raises(ValueError, match="on_s/off_s"):
+        soak.bursty_stream(20.0, 1.0, on_s=0)
+
+
+def test_soak_cli_bursty_flag(tmp_path, capsys):
+    rc = soak.main(["--bursty", "--arrival-rate", "30",
+                    "--duration", "0.3", "--nodes", "2",
+                    "--trace-len", "4", "--virtual-clock",
+                    "--out", str(tmp_path / "doc.json")])
+    assert rc == 0
+    doc = json.loads((tmp_path / "doc.json").read_text())
+    assert doc["jobs_quiesced"] == doc["jobs_total"] > 0
+    capsys.readouterr()
+
+
+def test_zipf_hotspot_workload_skew_and_registry():
+    import jax
+    import numpy as np
+    from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+    from ue22cs343bb1_openmp_assignment_tpu.models import workloads
+    assert "zipf_hotspot" in workloads.GENERATORS
+    cfg = SystemConfig(num_nodes=4)
+    op, addr, val, count = workloads.zipf_hotspot(
+        jax.random.PRNGKey(3), cfg, 128)
+    op2, addr2, _, _ = workloads.zipf_hotspot(
+        jax.random.PRNGKey(3), cfg, 128)
+    assert (np.array(addr) == np.array(addr2)).all()
+    assert (np.array(op) == np.array(op2)).all()
+    assert op.shape == addr.shape == (4, 128)
+    assert (np.array(count) == 128).all()
+    # popularity skew: the hottest block takes far more than the
+    # uniform share of a 64-rank universe
+    _, counts = np.unique(np.array(addr), return_counts=True)
+    assert counts.max() / counts.sum() > 4.0 / 64
+    # and it runs end to end through the serving stack
+    from ue22cs343bb1_openmp_assignment_tpu import serve
+    dumps = serve.solo_dumps(JobSpec(name="z", workload="zipf_hotspot",
+                                     nodes=2, trace_len=8))
+    assert len(dumps) == 2

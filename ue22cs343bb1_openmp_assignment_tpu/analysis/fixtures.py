@@ -12,7 +12,9 @@ hand-build cases. Readers: :func:`replay` (the full differential-oracle
 chain via ``fuzz.run_case`` — hang, state, invariant, consistency,
 coherence, sync), litmus seed replay, and external captures — all
 through this one module, the first step of ROADMAP item 4's
-record/replay story.
+record/replay story. The universal front door is ``cache-sim replay``
+(:mod:`..replay`): it auto-detects a fixture among every other
+captured artifact kind and routes it here.
 
 Everything here is host-side plumbing; no jit, no tracing.
 """
@@ -75,7 +77,10 @@ def load(path: str) -> dict:
         meta = json.load(f)
     if meta.get("schema") != SCHEMA:
         raise ValueError(f"{path}: schema must be {SCHEMA!r}, "
-                         f"got {meta.get('schema')!r}")
+                         f"got {meta.get('schema')!r} — for other "
+                         "captured artifacts (recordings, incident "
+                         "dirs) use `cache-sim replay`, which "
+                         "auto-detects the kind")
     for k in ("verdict", "case"):
         if k not in meta:
             raise ValueError(f"{path}: missing key {k!r}")

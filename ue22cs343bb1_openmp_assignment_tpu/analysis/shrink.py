@@ -110,6 +110,39 @@ def shrink_case(case: fuzz.FuzzCase,
             "instrs_after": len(kept)}
 
 
+def shrink_recording(rec: dict,
+                     predicate: Callable[[dict], bool]
+                     ) -> Tuple[dict, int]:
+    """ddmin over a traffic recording's JOB LIST: jobs — not
+    instructions — are the atoms here, because the failure being
+    preserved (an SLO breach, a stats anomaly) is a property of the
+    SCHEDULE, not of any one trace. ``predicate`` takes a
+    sub-recording (obs.recording doc) and answers "does the failure
+    still reproduce on replay?"; it must hold on the full recording.
+    Returns ``(minimal sub-recording, replays run)`` — 1-minimal:
+    dropping any single remaining job loses the failure. Predicate
+    results are memoized per job subset, so each distinct candidate
+    replays once."""
+    from ue22cs343bb1_openmp_assignment_tpu.obs import recording
+    jobs = [row["job"] for row in rec["rows"]
+            if row["event"] == "submit"]
+    cache: dict = {}
+    runs = [0]
+
+    def test(names: list) -> bool:
+        key = frozenset(names)
+        if key not in cache:
+            runs[0] += 1
+            cache[key] = bool(predicate(recording.subset(rec, key)))
+        return cache[key]
+
+    if not test(jobs):
+        raise ValueError("refusing to shrink: the predicate does not "
+                         "hold on the full recording")
+    kept = ddmin(jobs, test)
+    return recording.subset(rec, kept), runs[0]
+
+
 # -- repro emission --------------------------------------------------------
 
 # kept as an alias: obs/flight.py and older callers import the private

@@ -41,11 +41,14 @@ def build_parser() -> argparse.ArgumentParser:
                                           "false_sharing_vars",
                                           "false_sharing_vars_padded",
                                           "fft", "radix",
-                                          "hotspot", "lu"],
+                                          "hotspot", "zipf_hotspot",
+                                          "lu"],
                    help="run a synthetic workload instead of trace files "
                         "(fft/radix are SPLASH-2-style reference "
                         "patterns; false_sharing_vars[_padded] is the "
-                        "colliding-variables stress and its padding fix)")
+                        "colliding-variables stress and its padding fix; "
+                        "zipf_hotspot is the heavy-tailed Zipf address "
+                        "mix)")
     p.add_argument("--nodes", type=int, default=4)
     p.add_argument("--trace-len", type=int, default=32)
     p.add_argument("--queue-capacity", type=int, default=None,
@@ -639,6 +642,10 @@ def main(argv=None) -> int:
         from ue22cs343bb1_openmp_assignment_tpu.daemon import (
             client as daemon_client)
         return daemon_client.main(raw[1:])
+    if raw[:1] == ["replay"]:
+        from ue22cs343bb1_openmp_assignment_tpu import (
+            replay as replay_mod)
+        return replay_mod.main(raw[1:])
     args = build_parser().parse_args(raw)
     if args.cpu:
         import jax

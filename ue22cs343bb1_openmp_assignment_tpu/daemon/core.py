@@ -64,6 +64,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ue22cs343bb1_openmp_assignment_tpu.daemon import bucketing, protocol
+from ue22cs343bb1_openmp_assignment_tpu.obs import recording
 from ue22cs343bb1_openmp_assignment_tpu.obs.clock import MonotonicClock
 from ue22cs343bb1_openmp_assignment_tpu.serve import (
     JobSpec, SpanBook, build_job_arrays, build_job_state, job_config,
@@ -144,7 +145,8 @@ class DaemonCore:
                  lane_depth: int = protocol.DEFAULT_LANE_DEPTH,
                  lane_weights: Optional[Dict[str, int]] = None,
                  clock=None, out_dir=None, keep_dumps: bool = True,
-                 retain_results: int = protocol.DEFAULT_RETAIN_RESULTS):
+                 retain_results: int = protocol.DEFAULT_RETAIN_RESULTS,
+                 recorder: Optional[recording.RecordingWriter] = None):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if max_buckets < 1:
@@ -166,6 +168,7 @@ class DaemonCore:
                         else None)
         self.keep_dumps = keep_dumps
         self.retain_results = retain_results
+        self.recorder = recorder
         self.t_start = self.clock.now()
         self.book = SpanBook(self.clock)
         self.lanes: Dict[str, _Lane] = {
@@ -242,6 +245,13 @@ class DaemonCore:
         self._max_shape = (shape if self._max_shape is None
                            else bucketing.cover(self._max_shape, shape))
         self._sample()
+        if self.recorder is not None:
+            # every ACCEPTED submission is one recording row: the
+            # full spec, the lane, the SCHEDULED arrival time on the
+            # injected clock, and the queue depth at accept
+            self.recorder.submit(
+                spec, lane, t - self.t_start,
+                sum(len(x.queue) for x in self.lanes.values()))
         return {**base, "status": "queued"}
 
     # lint: host
@@ -475,6 +485,12 @@ class DaemonCore:
             "metrics": metrics,
         }
         dumps = job_dumps(b.scfg, jcfg, jstate)
+        # the digest is computed HERE, from the dumps, before the
+        # _retire below may evict this very doc: a recording's digest
+        # column stays complete even for jobs a bounded daemon no
+        # longer retains (lifetime counters were already exact; this
+        # makes the byte-parity fingerprint exact too)
+        doc["digest"] = recording.digest(dumps)
         if self.keep_dumps:
             doc["dumps"] = dumps
         if self.out_dir is not None:
@@ -486,6 +502,9 @@ class DaemonCore:
                 json.dumps({k: v for k, v in doc.items()
                             if k != "dumps"}, indent=2) + "\n")
         self.book.extracted(spec.name)
+        if self.recorder is not None:
+            self.recorder.result(spec.name, t_end - self.t_start, ok,
+                                 doc["digest"], doc["cycles"], b.label)
         self.results[spec.name] = doc
         self._status[spec.name] = "done"
         self._quiesced_total += int(ok)
@@ -501,6 +520,21 @@ class DaemonCore:
         b.real_by_slot[i] = 0
 
     # -- reporting ---------------------------------------------------------
+
+    # lint: host
+    def record_config(self) -> dict:
+        """The scheduler knobs a recording's header carries — enough
+        for ``cache-sim replay`` to rebuild an equivalent core, so an
+        in-proc replay of a VirtualClock session is bit-faithful by
+        default."""
+        return {
+            "slots": self.slots, "max_buckets": self.max_buckets,
+            "chunk": self.chunk, "max_cycles": self.max_cycles,
+            "queue_capacity": self.queue_capacity,
+            "lane_depth": max(ln.depth for ln in self.lanes.values()),
+            "lane_weights": {name: ln.weight for name, ln
+                             in sorted(self.lanes.items())},
+        }
 
     # lint: host
     def stats(self) -> dict:
@@ -557,6 +591,11 @@ class DaemonCore:
             "queue_depth_peak": self.queue_depth_peak,
             "retain_results": self.retain_results,
             "results_evicted": self.results_evicted,
+            "recording": (None if self.recorder is None else {
+                "path": self.recorder.path,
+                "submits": self.recorder.submits,
+                "results": self.recorder.results,
+            }),
             "padding_waste": (
                 1.0 - self._real_total / self._budget_total
                 if self._budget_total else None),
@@ -569,6 +608,17 @@ class DaemonCore:
         """Completed jobs as the validated serve-trace doc (spans
         carry the daemon's lane/bucket annotations)."""
         return serve_trace_doc(self.book.spans(), self.clock.kind)
+
+
+# lint: host
+def attach_recorder(core: DaemonCore,
+                    path) -> recording.RecordingWriter:
+    """Open a ``cache-sim/recording/v1`` writer on ``path`` (file or
+    directory) and attach it to the core; every accepted submission
+    and finished job from here on is streamed to it."""
+    core.recorder = recording.RecordingWriter(
+        path, core.clock.kind, core.record_config())
+    return core.recorder
 
 
 # lint: host

@@ -24,6 +24,10 @@ Ops
              Only the newest ``DEFAULT_RETAIN_RESULTS`` terminal jobs
              are retained; older jobs answer ``unknown``.
 ``stats``    → the validated ``cache-sim/daemon-stats/v1`` snapshot.
+             Under ``daemon --record`` its ``recording`` block
+             carries the live capture counters (artifact path,
+             accepted submissions streamed, result digests written);
+             ``recording`` is null when record mode is off.
 ``trace``    → the ``cache-sim/serve-trace/v1`` doc of completed jobs.
 ``drain``    → stop admitting, flush queued + in-flight jobs, respond
              when idle.

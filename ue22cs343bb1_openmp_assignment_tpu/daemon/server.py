@@ -22,7 +22,8 @@ import threading
 from typing import Optional
 
 from ue22cs343bb1_openmp_assignment_tpu.daemon import protocol
-from ue22cs343bb1_openmp_assignment_tpu.daemon.core import DaemonCore
+from ue22cs343bb1_openmp_assignment_tpu.daemon.core import (
+    DaemonCore, attach_recorder)
 from ue22cs343bb1_openmp_assignment_tpu.serve import JobSpec
 
 #: scheduler poll tick when idle (seconds); submits wake it earlier
@@ -240,6 +241,22 @@ def main(argv=None) -> int:
                          "streams dumps to disk either way)")
     ap.add_argument("--out-dir", default=None,
                     help="also stream per-job dumps + metrics here")
+    ap.add_argument("--record", default=None, metavar="DIR",
+                    help="record mode: stream every ACCEPTED "
+                         "submission (full spec, lane, scheduled "
+                         "arrival time) and every finished job's "
+                         "dump digest into DIR/recording.jsonl "
+                         "(cache-sim/recording/v1) — replay the "
+                         "captured traffic later with "
+                         "`cache-sim replay DIR`")
+    ap.add_argument("--virtual-clock", action="store_true",
+                    help="run the scheduler on the deterministic "
+                         "VirtualClock (time advances per wave, not "
+                         "by wall time) — recordings and trace docs "
+                         "then carry virtual timestamps; tests/CI")
+    ap.add_argument("--wave-s", type=float, default=1e-3,
+                    help="virtual seconds charged per wave chunk "
+                         "under --virtual-clock (default 1e-3)")
     ap.add_argument("--quiet", action="store_true")
     ap.add_argument("--cpu", action="store_true",
                     help="force JAX_PLATFORMS=cpu (set before jax "
@@ -249,19 +266,32 @@ def main(argv=None) -> int:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
     weights = (parse_lane_weights(args.lane_weights)
                if args.lane_weights else None)
+    clock = None
+    if args.virtual_clock:
+        from ue22cs343bb1_openmp_assignment_tpu.obs.clock import (
+            VirtualClock)
+        clock = VirtualClock(wave_s=args.wave_s)
     core = DaemonCore(slots=args.slots, max_buckets=args.max_buckets,
                       chunk=args.chunk, max_cycles=args.max_cycles,
                       queue_capacity=args.queue_capacity,
                       lane_depth=args.lane_depth, lane_weights=weights,
-                      out_dir=args.out_dir,
+                      clock=clock, out_dir=args.out_dir,
                       keep_dumps=args.keep_dumps,
                       retain_results=args.retain)
+    if args.record:
+        recorder = attach_recorder(core, args.record)
+        if not args.quiet:
+            print(f"daemon: recording traffic to {recorder.path}",
+                  flush=True)
     server = DaemonServer(core, args.addr, quiet=args.quiet)
     try:
         return server.run()
     except KeyboardInterrupt:
         server.stop()
         return 0
+    finally:
+        if core.recorder is not None:
+            core.recorder.close()
 
 
 if __name__ == "__main__":

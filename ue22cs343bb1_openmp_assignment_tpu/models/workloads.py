@@ -208,6 +208,46 @@ def hotspot(key, cfg: SystemConfig, trace_len: int,
     return op, addr, val, jnp.full((N,), trace_len, jnp.int32)
 
 
+def zipf_hotspot(key, cfg: SystemConfig, trace_len: int,
+                 exponent: float = 1.2, hot_ranks: int = 64,
+                 write_frac: float = 0.5):
+    """Heavy-tailed popularity workload: block popularity follows a
+    truncated Zipf law (rank r drawn with probability ∝ r^-exponent
+    over the `hot_ranks` most popular blocks), every node sampling
+    from the SAME popularity ranking.
+
+    `hotspot` gives temporal locality (each node revisits its own
+    small set); this gives POPULARITY skew — a handful of globally hot
+    blocks absorb most of the traffic from every node at once, the
+    web/KV-cache access law. Rank 1 alone carries ~1/H share, so the
+    directory entries of the head blocks see wide sharer sets and
+    constant upgrade/invalidate churn while the tail stays cold — the
+    worst case for home-node serialization that a uniform stream never
+    produces. Inverse-CDF sampling keeps it exact and fully batched.
+    """
+    N = cfg.num_nodes
+    k1, k2, k3 = jax.random.split(key, 3)
+    shape = (N, trace_len)
+    n_ranks = min(int(hot_ranks), N * cfg.mem_size)
+    ranks = jnp.arange(1, n_ranks + 1, dtype=jnp.float32)
+    weights = ranks ** jnp.float32(-exponent)
+    cdf = jnp.cumsum(weights) / jnp.sum(weights)
+    u = jax.random.uniform(k1, shape)
+    rank = jnp.searchsorted(cdf, u).astype(jnp.int32)
+    # rank → block: hash so consecutive ranks land on unrelated homes
+    # (popularity is a property of the block, not of an address range)
+    h = (rank.astype(jnp.uint32) + jnp.uint32(1)) \
+        * jnp.uint32(0x9E3779B9)
+    node = ((h >> 8).astype(jnp.int32) & 0x7FFF) % N
+    block = ((h >> 16).astype(jnp.int32) & 0x7FFF) % cfg.mem_size
+    addr = codec.make_address(cfg, node, block)
+    is_write = jax.random.uniform(k2, shape) < write_frac
+    op = jnp.where(is_write, int(Op.WRITE),
+                   int(Op.READ)).astype(jnp.int32)
+    val = jax.random.randint(k3, shape, 0, 256, dtype=jnp.int32)
+    return op, addr, val, jnp.full((N,), trace_len, jnp.int32)
+
+
 def lu_blocked(key, cfg: SystemConfig, trace_len: int):
     """SPLASH-2 LU-style blocked-factorization reference pattern.
 
@@ -273,5 +313,6 @@ GENERATORS = {
     "radix": radix_sort,
     "lu": lu_blocked,
     "hotspot": hotspot,
+    "zipf_hotspot": zipf_hotspot,
     "procedural_uniform": procedural_uniform,
 }

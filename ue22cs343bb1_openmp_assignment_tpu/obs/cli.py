@@ -32,7 +32,7 @@ import os
 import sys
 
 WORKLOADS = ["uniform", "producer_consumer", "false_sharing", "fft",
-             "radix", "hotspot", "lu"]
+             "radix", "hotspot", "zipf_hotspot", "lu"]
 
 
 # lint: host
@@ -1007,6 +1007,12 @@ def build_dashboard_parser() -> argparse.ArgumentParser:
                    help="analyze --litmus --json report (or the bare "
                         "litmus.run_suite dict); renders as the "
                         "protocol x consistency-test matrix")
+    p.add_argument("--recording", metavar="PATH", action="append",
+                   default=[],
+                   help="a cache-sim/recording/v1 capture (daemon "
+                        "--record artifact or record dir); repeatable; "
+                        "renders as the captured-traffic table, each "
+                        "row replayable with cache-sim replay")
     return p
 
 
@@ -1037,6 +1043,7 @@ def cmd_dashboard(args) -> int:
         return 2
     entries = []
     litmus = None
+    recordings = []
     try:
         if args.history:
             entries.extend(history.load(args.history))
@@ -1050,11 +1057,16 @@ def cmd_dashboard(args) -> int:
                 else None
             if not isinstance(litmus, dict):
                 raise ValueError(f"{args.litmus}: not a litmus report")
+        if args.recording:
+            from ue22cs343bb1_openmp_assignment_tpu.obs import (
+                recording)
+            recordings = [recording.load(p) for p in args.recording]
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     res = dashboard.render(entries, html_path=args.html,
-                           md_path=args.md, litmus=litmus)
+                           md_path=args.md, litmus=litmus,
+                           recordings=recordings)
     if args.json:
         print(json.dumps(res["model"], sort_keys=True))
     for path in (args.html, args.md):

@@ -69,9 +69,35 @@ def _litmus_cells(litmus: Optional[dict]) -> list:
 
 
 # lint: host
+def _recording_rows(recordings: Optional[list]) -> list:
+    """Normalize loaded ``cache-sim/recording/v1`` docs
+    (obs.recording.load) into the captured-traffic table rows."""
+    from ue22cs343bb1_openmp_assignment_tpu.obs import recording
+    rows = []
+    for rec in recordings or []:
+        submits = [r for r in rec["rows"] if r["event"] == "submit"]
+        results = [r for r in rec["rows"] if r["event"] == "result"]
+        ts = [float(r["t_s"]) for r in rec["rows"]]
+        lat = recording.latency_block(rec)
+        rows.append({
+            "label": rec.get("path") or "?",
+            "clock": rec["clock"],
+            "jobs": len(submits),
+            "results": len(results),
+            "quiesced": sum(1 for r in results if r["quiesced"]),
+            "duration_s": (max(ts) - min(ts)) if ts else 0.0,
+            "arrival_rate": (recording.derived_arrival_rate(rec)
+                             if submits else None),
+            "p95_ms": None if lat is None else lat["p95_ms"],
+        })
+    return rows
+
+
+# lint: host
 def build_model(entries: List[dict],
                 target: float = TARGET_INSTRS_PER_S,
-                litmus: Optional[dict] = None) -> dict:
+                litmus: Optional[dict] = None,
+                recordings: Optional[list] = None) -> dict:
     """Reduce a loaded history to the renderable model.
 
     Splits entries into the instrs/sec headline series, the multichip
@@ -80,7 +106,10 @@ def build_model(entries: List[dict],
     cell; protocol defaults to "mesi" until ROADMAP item 4 records
     one), and the roofline points of every recorded cost vector.
     ``litmus`` is an optional ``analyze --litmus`` suite report; it
-    becomes the protocol x test consistency matrix.
+    becomes the protocol x test consistency matrix. ``recordings`` is
+    an optional list of loaded traffic recordings (obs.recording);
+    they become the captured-traffic table, each row replayable with
+    ``cache-sim replay``.
     """
     bench = [e for e in entries if e.get("unit") == "instrs/sec"]
     multichip = [e for e in entries
@@ -148,6 +177,7 @@ def build_model(entries: List[dict],
             "roofline": points, "scaling": scaling,
             "serving": serving, "latency": latency,
             "litmus": _litmus_cells(litmus),
+            "recordings": _recording_rows(recordings),
             "n_entries": len(entries)}
 
 
@@ -331,6 +361,30 @@ def _litmus_html(cells: list) -> str:
 
 
 # lint: host
+def _recordings_html(rows: list) -> str:
+    if not rows:
+        return ("<p><em>no recordings loaded (capture with "
+                "cache-sim daemon --record DIR, then dashboard "
+                "--recording DIR)</em></p>")
+    trs = []
+    for r in rows:
+        rate = ("—" if r["arrival_rate"] is None
+                else f"{r['arrival_rate']:g}/s")
+        p95 = "—" if r["p95_ms"] is None else f"{r['p95_ms']:.4g} ms"
+        trs.append(f"<tr><td>{r['label']}</td><td>{r['clock']}</td>"
+                   f"<td>{r['jobs']}</td>"
+                   f"<td>{r['quiesced']}/{r['results']}</td>"
+                   f"<td>{r['duration_s']:.4g} s</td>"
+                   f"<td>{rate}</td><td>{p95}</td></tr>")
+    return ("<table><tr><th>recording</th><th>clock</th>"
+            "<th>jobs</th><th>quiesced/results</th><th>window</th>"
+            "<th>offered load</th><th>recorded p95</th></tr>"
+            + "".join(trs) + "</table>"
+            "<p>replay any row with <code>cache-sim replay "
+            "&lt;recording&gt;</code>.</p>")
+
+
+# lint: host
 def render_html(model: dict) -> str:
     """The self-contained static HTML report."""
     rows = []
@@ -370,6 +424,8 @@ td, th {{ border: 1px solid #d5dbdb; padding: 4px 10px;
 {_svg_series("serving", model["serving"], "value", None, "jobs/sec")}
 <h2>Open-loop job latency (p95 ms)</h2>
 {_svg_series("latency", model["latency"], "value", None, "ms p95")}
+<h2>Recordings (captured traffic)</h2>
+{_recordings_html(model["recordings"])}
 <h2>bench-diff verdicts (adjacent pairs)</h2>
 {verdict_html}
 <h2>Coverage: protocol &times; workload</h2>
@@ -433,6 +489,27 @@ def render_markdown(model: dict) -> str:
     else:
         lines.append("*no latency entries yet (bench.py --soak "
                      "--record)*")
+    lines += ["", "## Recordings (captured traffic)", ""]
+    if model["recordings"]:
+        lines += ["| recording | clock | jobs | quiesced/results "
+                  "| window | offered load | recorded p95 |",
+                  "|---|---|---:|---:|---:|---:|---:|"]
+        for r in model["recordings"]:
+            rate = ("—" if r["arrival_rate"] is None
+                    else f"{r['arrival_rate']:g}/s")
+            p95 = ("—" if r["p95_ms"] is None
+                   else f"{r['p95_ms']:.4g} ms")
+            lines.append(f"| {r['label']} | {r['clock']} | {r['jobs']} "
+                         f"| {r['quiesced']}/{r['results']} "
+                         f"| {r['duration_s']:.4g} s | {rate} "
+                         f"| {p95} |")
+        lines.append("")
+        lines.append("replay any row with `cache-sim replay "
+                     "<recording>`.")
+    else:
+        lines.append("*no recordings loaded (capture with cache-sim "
+                     "daemon --record DIR, then dashboard "
+                     "--recording DIR)*")
     lines += ["", "## bench-diff verdicts (adjacent pairs)", ""]
     if model["verdicts"]:
         lines += ["| pair | verdict | delta |", "|---|---|---:|"]
@@ -487,10 +564,11 @@ def render_markdown(model: dict) -> str:
 # lint: host
 def render(entries: List[dict], html_path: Optional[str] = None,
            md_path: Optional[str] = None,
-           litmus: Optional[dict] = None) -> dict:
+           litmus: Optional[dict] = None,
+           recordings: Optional[list] = None) -> dict:
     """Build the model and write the requested artifacts; returns
     ``{"model", "html_path", "md_path"}``."""
-    model = build_model(entries, litmus=litmus)
+    model = build_model(entries, litmus=litmus, recordings=recordings)
     if html_path:
         with open(html_path, "w") as f:
             f.write(render_html(model))

@@ -21,7 +21,9 @@ by hand. The flight recorder turns each of those into a self-contained
 - ``core_<n>.txt`` + ``repro.json`` — when the incident came from a
   fuzz case, the exact ``cache-sim/repro/v1`` fixture format
   analysis/shrink.py emits, so :func:`replay_incident` (and the
-  reference simulator itself) can re-run it.
+  reference simulator itself) can re-run it. ``cache-sim replay
+  <dir>`` is the front door: it detects a flight incident among the
+  other captured artifact kinds and calls :func:`replay_incident`.
 
 The ring is captured by looping ``ops.step.run_cycles_telemetry`` in
 small chunks host-side and keeping only the last K samples — memory is

@@ -440,8 +440,14 @@ _DAEMON_TOP_KEYS = ("schema", "clock", "uptime_s", "draining", "jobs",
                     "drain_rate_jobs_per_s", "mb_dropped",
                     "mid_wave_swaps", "bucket_growths",
                     "queue_depth_peak", "retain_results",
-                    "results_evicted", "padding_waste",
+                    "results_evicted", "recording", "padding_waste",
                     "single_shape_padding_waste")
+
+#: the live-capture counters a recording daemon reports (``recording``
+#: is None when ``--record`` is off): the artifact path plus exact
+#: lifetime row counts — ``submits`` accepted submissions streamed,
+#: ``results`` digest rows written (obs.recording)
+_DAEMON_RECORDING_KEYS = ("path", "submits", "results")
 
 _DAEMON_JOB_KEYS = ("submitted", "rejected", "done", "quiesced")
 
@@ -506,6 +512,21 @@ def validate_daemon_stats(doc: dict) -> dict:
                               or isinstance(v, bool)
                               or not 0.0 <= float(v) <= 1.0):
             errs.append(f"{k} must be None or in [0, 1], got {v!r}")
+    rec = doc.get("recording")
+    if rec is not None:
+        if not isinstance(rec, dict):
+            errs.append("recording must be None or a dict "
+                        f"{{{', '.join(_DAEMON_RECORDING_KEYS)}}}")
+        else:
+            for k in _DAEMON_RECORDING_KEYS:
+                if k not in rec:
+                    errs.append(f"recording: missing key {k}")
+            for k in ("submits", "results"):
+                v = rec.get(k)
+                if (not isinstance(v, int) or isinstance(v, bool)
+                        or v < 0):
+                    errs.append(f"recording.{k} must be a "
+                                f"non-negative int, got {v!r}")
     if errs:
         raise ValueError("invalid daemon stats:\n  " + "\n  ".join(errs))
     return doc

@@ -102,6 +102,75 @@ def soak_stream(arrival_rate: float, duration_s: float, nodes: int = 4,
 
 
 # lint: host
+def bursty_stream(arrival_rate: float, duration_s: float,
+                  nodes: int = 4, trace_len: int = 8,
+                  protocol: str = "mesi",
+                  mix: Tuple[str, ...] = DEFAULT_MIX, seed: int = 0,
+                  on_s: float = 0.25, off_s: float = 0.25,
+                  peak_factor: float = 4.0
+                  ) -> List[Tuple[float, JobSpec]]:
+    """On/off (interrupted) Poisson arrivals: exponentially
+    distributed ON windows (mean ``on_s``) emit a Poisson stream at
+    ``arrival_rate * peak_factor`` jobs/s, alternating with silent
+    OFF windows (mean ``off_s``) — the heavy-tailed burst pattern a
+    uniform Poisson stream cannot produce (queues build during
+    bursts even when the machine keeps up with the AVERAGE rate).
+    Seeded-deterministic like :func:`soak_stream`: same (rate,
+    duration, seed, on/off/peak) → the same schedule, byte for byte.
+    """
+    if arrival_rate <= 0:
+        raise ValueError(f"arrival_rate must be > 0, got {arrival_rate}")
+    if duration_s <= 0:
+        raise ValueError(f"duration_s must be > 0, got {duration_s}")
+    if on_s <= 0 or off_s <= 0:
+        raise ValueError(f"on_s/off_s must be > 0, got {on_s}/{off_s}")
+    if peak_factor <= 0:
+        raise ValueError(f"peak_factor must be > 0, got {peak_factor}")
+    rng = np.random.default_rng(seed)
+    peak = arrival_rate * peak_factor
+    arrivals: List[Tuple[float, JobSpec]] = []
+    t = 0.0
+    i = 0
+    on = True
+    window_end = float(rng.exponential(on_s))
+    while t < duration_s:
+        if not on:
+            # silent window: jump to its end, open the next burst
+            t = window_end
+            on = True
+            window_end = t + float(rng.exponential(on_s))
+            continue
+        gap = float(rng.exponential(1.0 / peak))
+        if t + gap >= window_end:
+            # burst over before the next arrival (memoryless, so the
+            # residual gap is simply redrawn in the next ON window)
+            t = window_end
+            on = False
+            window_end = t + float(rng.exponential(off_s))
+            continue
+        t += gap
+        if t >= duration_s:
+            break
+        arrivals.append((t, JobSpec(
+            name=f"job{i:03d}", workload=mix[i % len(mix)], nodes=nodes,
+            trace_len=trace_len, seed=i, protocol=protocol)))
+        i += 1
+    return arrivals
+
+
+# lint: host
+def recorded_stream(source) -> List[Tuple[float, JobSpec, str]]:
+    """Schedule-from-recording: a ``cache-sim/recording/v1`` artifact
+    (path, directory, or loaded doc) → the open-loop schedule
+    ``[(t_s, JobSpec, lane)]`` with the ORIGINAL arrival times and
+    lanes preserved — yesterday's live traffic as today's soak
+    schedule (replay it with ``cache-sim replay``)."""
+    from ue22cs343bb1_openmp_assignment_tpu.obs import recording
+    rec = source if isinstance(source, dict) else recording.load(source)
+    return recording.arrivals(rec)
+
+
+# lint: host
 def soak(arrivals, slots: int = 4, slot_nodes: Optional[int] = None,
          slot_trace_len: Optional[int] = None, chunk: int = 32,
          max_cycles: int = 100_000, queue_capacity: int = 64,
@@ -271,7 +340,8 @@ def soak_daemon(arrivals, addr: str,
                 arrival_rate: Optional[float] = None,
                 lane_mix: Tuple[str, ...] = ("interactive", "batch"),
                 poll_s: float = 0.002, timeout_s: float = 300.0,
-                prefix: str = "", quiet: bool = True) -> dict:
+                prefix: str = "", quiet: bool = True,
+                lanes: Optional[List[str]] = None) -> dict:
     """Drive the same open-loop arrival schedule through a RUNNING
     daemon's socket instead of in-process waves.
 
@@ -293,6 +363,11 @@ def soak_daemon(arrivals, addr: str,
     ``prefix`` is prepended to every job name: a daemon rejects
     duplicate names, so successive soaks against the SAME daemon must
     use distinct prefixes (the CLI derives one from ``--seed``).
+
+    ``lanes`` pins each arrival's lane explicitly (aligned with the
+    input ``arrivals`` order) — the replay path uses it to preserve a
+    recording's ORIGINAL lane per job; by default jobs alternate
+    through ``lane_mix``.
     """
     import dataclasses
     import time as _time
@@ -301,12 +376,19 @@ def soak_daemon(arrivals, addr: str,
         DaemonClient)
     from ue22cs343bb1_openmp_assignment_tpu.obs import timeseries
 
-    arrivals = sorted(
-        ((t, dataclasses.replace(spec, name=prefix + spec.name))
-         for t, spec in arrivals), key=lambda a: a[0])
-    if not arrivals:
+    items = list(arrivals)
+    if lanes is None:
+        lanes = [lane_mix[i % len(lane_mix)] for i in range(len(items))]
+    if len(lanes) != len(items):
+        raise ValueError(f"lanes must align with arrivals: "
+                         f"{len(lanes)} vs {len(items)}")
+    items = sorted(
+        ((t, dataclasses.replace(spec, name=prefix + spec.name), lane)
+         for (t, spec), lane in zip(items, lanes)),
+        key=lambda a: (a[0], a[1].name))
+    if not items:
         raise ValueError("soak needs at least one arrival")
-    lanes = [lane_mix[i % len(lane_mix)] for i in range(len(arrivals))]
+    lanes = [lane for _, _, lane in items]
 
     clock = MonotonicClock()
     with DaemonClient(addr) as client:
@@ -314,7 +396,7 @@ def soak_daemon(arrivals, addr: str,
         t_start = clock.now()
         deadline = t_start + timeout_s
         pending = [(t_start + dt, spec, lane)
-                   for (dt, spec), lane in zip(arrivals, lanes)]
+                   for dt, spec, lane in items]
         outstanding: Dict[str, Tuple[float, str]] = {}
         done: Dict[str, dict] = {}
         e2e: Dict[str, Tuple[float, str]] = {}
@@ -465,15 +547,21 @@ def check_slo(latency: Optional[dict],
 
 
 # lint: host
-def dump_incident(out_dir, doc: dict, breaches: List[dict]) -> dict:
+def dump_incident(out_dir, doc: dict, breaches: List[dict],
+                  rec: Optional[dict] = None) -> dict:
     """Write a self-contained SLO-breach incident directory (the
     flight-recorder convention, obs.flight): ``incident.json`` — the
     breaches, the latency block, the backpressure verdict, the
     ``INCIDENT_SLOWEST`` slowest jobs' full spans, and the queue-depth
     time series — plus ``trace.perfetto.json``, the Perfetto rendering
-    of every job's lifecycle with flow arrows. Returns the incident
-    doc."""
-    from ue22cs343bb1_openmp_assignment_tpu.obs import perfetto
+    of every job's lifecycle with flow arrows. When the soak was
+    driven from a traffic recording (``rec``, an obs.recording doc),
+    the BREACH-WINDOW slice — every job submitted between the first
+    submit and last extract of the slowest jobs — is embedded as
+    ``recording.jsonl``, making the incident dir itself replayable
+    (``cache-sim replay <dir>``). Returns the incident doc."""
+    from ue22cs343bb1_openmp_assignment_tpu.obs import (perfetto,
+                                                       recording)
     out_dir = str(out_dir)
     os.makedirs(out_dir, exist_ok=True)
     spans = doc["trace"]["spans"]
@@ -481,6 +569,14 @@ def dump_incident(out_dir, doc: dict, breaches: List[dict]) -> dict:
     perfetto.write_trace(
         os.path.join(out_dir, "trace.perfetto.json"), trace)
     slowest = sorted(spans, key=lambda s: (-s["e2e_s"], s["job"]))
+    files = ["incident.json", "trace.perfetto.json"]
+    if rec is not None:
+        window = slowest[:INCIDENT_SLOWEST]
+        t_lo = min(s["t_submit"] for s in window)
+        t_hi = max(s["t_extracted"] for s in window)
+        recording.write(os.path.join(out_dir, recording.FILENAME),
+                        recording.slice_window(rec, t_lo, t_hi))
+        files.append(recording.FILENAME)
     inc = {
         "schema": INCIDENT_SCHEMA_ID,
         "reason": "slo-breach",
@@ -492,7 +588,7 @@ def dump_incident(out_dir, doc: dict, breaches: List[dict]) -> dict:
         "slowest_jobs": slowest[:INCIDENT_SLOWEST],
         "series": doc["series"],
         "series_summary": doc["series_summary"],
-        "files": sorted(["incident.json", "trace.perfetto.json"]),
+        "files": sorted(files),
     }
     with open(os.path.join(out_dir, "incident.json"), "w") as f:
         json.dump(inc, f, indent=1, sort_keys=True)
@@ -543,6 +639,25 @@ def main(argv=None) -> int:
                          "(default mesi)")
     ap.add_argument("--seed", type=int, default=0,
                     help="arrival-schedule + workload seed (default 0)")
+    ap.add_argument("--bursty", action="store_true",
+                    help="use the on/off (interrupted) Poisson "
+                         "schedule instead of uniform Poisson: "
+                         "exponential ON windows at --arrival-rate x "
+                         "--burst-peak alternate with silent OFF "
+                         "windows — heavy-tailed load that builds "
+                         "queues even at a sustainable AVERAGE rate")
+    ap.add_argument("--burst-on", type=float, default=0.25,
+                    metavar="S",
+                    help="mean ON-window length in seconds under "
+                         "--bursty (default 0.25)")
+    ap.add_argument("--burst-off", type=float, default=0.25,
+                    metavar="S",
+                    help="mean OFF-window length in seconds under "
+                         "--bursty (default 0.25)")
+    ap.add_argument("--burst-peak", type=float, default=4.0,
+                    metavar="X",
+                    help="in-burst rate multiplier over --arrival-rate "
+                         "under --bursty (default 4.0)")
     ap.add_argument("--chunk", type=int, default=32)
     ap.add_argument("--max-cycles", type=int, default=100_000)
     ap.add_argument("--queue-capacity", type=int, default=64)
@@ -586,9 +701,17 @@ def main(argv=None) -> int:
                  "the socket; it cannot run on --virtual-clock "
                  "(the daemon owns its own clock)")
 
-    arrivals = soak_stream(args.arrival_rate, args.duration,
-                           nodes=args.nodes, trace_len=args.trace_len,
-                           protocol=args.protocol, seed=args.seed)
+    if args.bursty:
+        arrivals = bursty_stream(
+            args.arrival_rate, args.duration, nodes=args.nodes,
+            trace_len=args.trace_len, protocol=args.protocol,
+            seed=args.seed, on_s=args.burst_on, off_s=args.burst_off,
+            peak_factor=args.burst_peak)
+    else:
+        arrivals = soak_stream(
+            args.arrival_rate, args.duration, nodes=args.nodes,
+            trace_len=args.trace_len, protocol=args.protocol,
+            seed=args.seed)
     if args.daemon:
         lane_mix = tuple(p.strip() for p in args.lane_mix.split(",")
                          if p.strip())
