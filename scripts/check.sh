@@ -7,10 +7,12 @@
 #
 # The analyze step is `cache-sim analyze`: the symmetry-reduced
 # protocol model checker over the builtin scopes, the JAX trace linter
-# over ops/ parallel/ models/ obs/, and the jaxpr IR lint + three-engine
-# recompilation guard (--jaxpr). It exits nonzero on any genuine
-# violation (reference-sanctioned quirks are reported but allowlisted);
-# exit 3 means a scope exhausted --max-states without a finding.
+# over ops/ parallel/ models/ obs/ plus the no-jax boundary pass over
+# the daemon wire layer, and the jaxpr IR lint (incl. the pinned
+# per-target index-site budgets) + three-engine recompilation guard
+# (--jaxpr). It exits nonzero on any genuine violation
+# (reference-sanctioned quirks are reported but allowlisted); exit 3
+# means a scope exhausted --max-states without a finding.
 #
 # The fuzz smoke is a fixed-seed, time-boxed run of the differential
 # fuzzer (async vs native vs sync; FUZZ_N cases, seed 0) — ≤30 s
@@ -212,6 +214,29 @@ if [[ "$rc" != 1 ]]; then
     exit 1
 fi
 echo "kernel-check smoke: ok (headline verified, seeded mutant caught)"
+
+# Index-pressure smoke (30s box): the static gather/scatter auditor
+# (analysis/indexcheck, `analyze --index`) over the async engine at
+# the canonical N=8 — per-plane attribution, site counts against the
+# pinned INDEX_BUDGETS, merge-candidate scan, and a bounded probe run
+# for the machine-derived indices/instr — then its own mutation test:
+# the seeded split_packed_scatter mutant re-splits the packed commit
+# bit-identically (invisible to every dynamic oracle) and must be
+# caught by the static pass alone (budget breach + merge candidates
+# naming the re-split planes — exit 1).
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.analysis \
+    --index --index-engine async --max-states 128 \
+    --skip-model-check --skip-lint
+rc=0
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.analysis \
+    --index --skip-model-check --skip-lint \
+    --mutation split_packed_scatter || rc=$?
+if [[ "$rc" != 1 ]]; then
+    echo "index smoke: seeded split_packed_scatter mutant was NOT"
+    echo "caught (exit $rc, want 1)"
+    exit 1
+fi
+echo "index smoke: ok (async inventory clean, seeded mutant caught)"
 
 # Serve smoke (30s box): 8 mixed-workload jobs packed into 4 slots
 # must all reach quiescence, and one job's batched dump must stay

@@ -1,0 +1,317 @@
+"""Index-pressure auditor (analysis/indexcheck) — the static
+gather/scatter attribution behind `analyze --index`.
+
+The load-bearing assertions avoid restating the module's constants
+where they can be re-derived: per-plane indices must sum to the
+engine's indices/step, every pinned budget must equal a freshly traced
+site count, the merge detector is exercised on synthetic jaxprs small
+enough to verify by hand, and the seeded mutation
+(INDEX_MUTATIONS.split_packed_scatter) must be killed by the static
+pass alone AND stay invisible to the dynamic semantics (bit-identical
+eager parity).
+
+Golden regen (deliberate inventory changes only):
+
+    JAX_PLATFORMS=cpu python - <<'PY'
+    import json
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import indexcheck
+    rep = indexcheck.check(engines=["async"], probe=False)
+    open("tests/golden/index_async_n8.json", "w").write(
+        json.dumps(rep, indent=2, sort_keys=True) + "\n")
+    PY
+"""
+
+import json
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ue22cs343bb1_openmp_assignment_tpu.analysis import indexcheck as ic
+from ue22cs343bb1_openmp_assignment_tpu.analysis import (lint_jaxpr,
+                                                         lint_trace,
+                                                         mutations,
+                                                         runner)
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.ops import step
+from ue22cs343bb1_openmp_assignment_tpu.state import init_state
+
+GOLDEN = pathlib.Path(__file__).parent / "golden" / "index_async_n8.json"
+
+
+# ------------------------------------------------------------- inventory
+
+
+def test_golden_async_inventory_byte_identical():
+    """The full async audit doc (all four targets, plane attribution,
+    signatures, budgets) is deterministic and pinned byte-for-byte:
+    any new index site, plane reattribution or signature drift shows
+    up as a golden diff, not a silent number change."""
+    rep = ic.check(engines=["async"], probe=False)
+    got = json.dumps(rep, indent=2, sort_keys=True) + "\n"
+    assert got == GOLDEN.read_text()
+
+
+def test_plane_split_sums_to_per_step_indices():
+    """by_plane is a partition: per-plane indices sum to the target's
+    indices/call, and the hot body's indices/call IS the engine's
+    indices/step."""
+    rep = ic.check(engines=["async", "sync"], probe=False)
+    for eng in ("async", "sync"):
+        er = rep["engines"][eng]
+        for name, t in er["targets"].items():
+            assert sum(v["indices"] for v in t["by_plane"].values()) \
+                == t["indices_per_call"], name
+            assert sum(r["indices"] for r in t["ops"]) \
+                == t["indices_per_call"], name
+        hot = er["hot_body"]
+        assert er["indices_per_step"] \
+            == er["targets"][hot]["indices_per_call"]
+
+
+@pytest.mark.slow
+def test_budgets_match_freshly_traced_sites():
+    """Every pinned budget equals a site count traced NOW — the table
+    can never drift from the code it describes (this is the assertion
+    that makes the PERF.md numbers machine-checked)."""
+    rep = ic.check(probe=False)     # all five engines
+    seen = {}
+    for er in rep["engines"].values():
+        for name, t in er["targets"].items():
+            seen[name] = t["index_sites"]
+    for name, budget in ic.INDEX_BUDGETS.items():
+        assert seen[name] == budget, name
+    assert rep["ok"], rep["findings"]
+
+
+def test_sites_independent_of_n():
+    """Budgets are pinned at DEFAULT_NODES but sites are a property of
+    the traced program, not the config size: N=4 traces the same
+    counts (and is reported as budgets_enforced=False)."""
+    rep = ic.check(engines=["async"], nodes=4, probe=False)
+    assert not rep["budgets_enforced"]
+    assert rep["ok"]
+    for name, t in rep["engines"]["async"]["targets"].items():
+        b = ic.INDEX_BUDGETS.get(name)
+        if b is not None:
+            assert t["index_sites"] == b, name
+
+
+def test_fused_round_has_no_gather_scatter():
+    """The fused kernel's whole point: the round body contains zero
+    gather/scatter primitives — its only index eqns are the window
+    dynamic slices. This is the cross-engine diff ROADMAP item 5
+    builds on."""
+    rep = ic.check(engines=["fused"], probe=False)
+    ops = rep["engines"]["fused"]["targets"][
+        "pallas_round.round_body"]["ops"]
+    prims = {o["primitive"] for o in ops}
+    assert not any(p == "gather" or p.startswith("scatter")
+                   for p in prims), prims
+
+
+# -------------------------------------------------------- merge detector
+
+
+def _ops_of(fn, *args):
+    closed = jax.make_jaxpr(fn)(*args)
+    names = [f"arg{i}" for i in range(len(args))]
+    return ic.inventory(closed, names, "t")
+
+
+def test_merge_candidate_positive_pair():
+    """Two scatters sharing one index vector into two different arrays
+    is exactly the PR-8 shape: one candidate naming both dests."""
+    def f(a, b, idx, u):
+        return (a.at[idx].set(u, mode="drop"),
+                b.at[idx].set(u + 1, mode="drop"))
+
+    a = jnp.zeros((8,), jnp.int32)
+    idx = jnp.arange(4, dtype=jnp.int32)
+    cands = ic.merge_candidates(_ops_of(f, a, a, idx, idx))
+    assert len(cands) == 1
+    assert cands[0]["count"] == 2
+    assert sorted(d.split("#")[0] for d in cands[0]["dests"]) \
+        == ["arg0", "arg1"]
+
+
+def test_merge_candidate_negative_different_index():
+    """Different index vectors (structurally different producers) must
+    NOT pair — there is no shared row to pack into."""
+    def f(a, b, idx, u):
+        return (a.at[idx].set(u, mode="drop"),
+                b.at[idx + 1].set(u, mode="drop"))
+
+    a = jnp.zeros((8,), jnp.int32)
+    idx = jnp.arange(4, dtype=jnp.int32)
+    assert ic.merge_candidates(_ops_of(f, a, a, idx, idx)) == []
+
+
+def test_merge_candidate_boundary_chained_same_dest():
+    """Two scatters chained into the SAME destination share the index
+    vector but are sequential writes to one buffer — not mergeable;
+    the dest-token anchoring must collapse the chain to one token."""
+    def f(a, idx, u):
+        return a.at[idx].set(u, mode="drop").at[idx].set(u + 1,
+                                                         mode="drop")
+
+    a = jnp.zeros((8,), jnp.int32)
+    idx = jnp.arange(4, dtype=jnp.int32)
+    assert ic.merge_candidates(_ops_of(f, a, idx, idx)) == []
+
+
+def test_shipped_engines_name_a_candidate():
+    """Acceptance: the detector names at least one concrete candidate
+    in the shipped engines (the RDMA router's header/payload pair)."""
+    rep = ic.check(engines=["async"], probe=False)
+    cands = rep["engines"]["async"]["merge_candidates"]
+    assert any("rdma_comm.route" in c["scope"] for c in cands)
+
+
+# ------------------------------------------------------- seeded mutation
+
+
+def test_index_mutants_killed_statically():
+    """Every seeded index mutant must be caught by the static pass
+    alone — budget breach plus merge candidates naming the re-split
+    planes — and the world must be clean after the context exits."""
+    for name, (cm, kind) in mutations.INDEX_MUTATIONS.items():
+        with cm():
+            rep = ic.check(engines=["async"], probe=False)
+        kinds = [f["kind"] for f in rep["findings"]]
+        assert not rep["ok"] and kind in kinds, (name, kinds)
+        cands = [c for c in rep["engines"]["async"]["merge_candidates"]
+                 if c["scope"].startswith("step.cycle")]
+        assert cands, "detector must hand back the consolidation"
+        dests = {d.split("#")[0] for c in cands for d in c["dests"]}
+        assert {"cache_state", "cache_addr", "cache_val"} <= dests
+    assert ic.check(engines=["async"], probe=False)["ok"]
+
+
+@pytest.mark.slow
+def test_split_commit_is_bit_identical_eagerly():
+    """The mutation's cover: the de-consolidated commit is semantically
+    invisible — eager per-plane commit equals the packed commit on
+    every state leaf, so only the static audit can see it."""
+    cfg = SystemConfig.scale(4)
+    traces = [[(0, 1, 7), (1, 1, 9)], [(0, 0, 0)],
+              [(2, 1, 3)], [(1, 0, 0)]]
+    ref = init_state(cfg, traces)
+    mut = init_state(cfg, traces)
+    for _ in range(12):
+        ref = step.cycle(cfg, ref)
+    with mutations.split_packed_scatter():
+        for _ in range(12):
+            mut = step.cycle(cfg, mut)
+    ref_leaves, _ = jax.tree_util.tree_flatten_with_path(ref)
+    mut_leaves, _ = jax.tree_util.tree_flatten_with_path(mut)
+    for (pa, la), (_, lb) in zip(ref_leaves, mut_leaves):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb),
+                                      err_msg=jax.tree_util.keystr(pa))
+
+
+def test_mutant_raises_site_count_everywhere_async():
+    """The split commit re-adds one gather+scatter per extra plane on
+    both commit rows: 27 -> 35 sites, on the cycle AND every wrapper
+    that traces through it."""
+    with mutations.split_packed_scatter():
+        rep = ic.check(engines=["async"], probe=False)
+    t = rep["engines"]["async"]["targets"]
+    assert t["step.cycle"]["index_sites"] == 35
+    assert t["step.run_cycles[8]"]["index_sites"] == 35
+    assert t["parallel.sharded_cycle"]["index_sites"] == 35
+
+
+# ------------------------------------------------- always-on jaxpr prong
+
+
+@pytest.mark.slow
+def test_lint_jaxpr_enforces_index_pins():
+    """The --jaxpr prong pins index sites exactly (mailbox-mode deltas
+    applied), covers the wave chunk as a first-class target, and the
+    mutant trips it without ever running --index."""
+    rep = lint_jaxpr.lint()
+    assert rep["ok"], rep["findings"]
+    assert "step.run_wave_chunk[2x4]" in rep["targets"]
+    assert rep["targets"]["step.run_wave_chunk[2x4]"] \
+        <= lint_jaxpr.EQN_BUDGETS["step.run_wave_chunk[2x4]"]
+    ref = SystemConfig.reference()
+    for name, sites in rep["index_sites"].items():
+        assert sites == ic.index_budget(name, ref.inv_mode), name
+    with mutations.split_packed_scatter():
+        bad = lint_jaxpr.lint()
+    rules = {f["rule"] for f in bad["findings"]}
+    assert not bad["ok"] and "index_budget" in rules
+
+
+# ------------------------------------------------------ no-jax boundary
+
+
+def test_daemon_wire_layer_is_jax_free():
+    targets = lint_trace.no_jax_targets()
+    assert [p.name for p in targets] == ["server.py", "client.py"]
+    assert all(p.exists() for p in targets)
+    assert lint_trace.lint_no_jax() == []
+
+
+def test_no_jax_flags_every_route_in():
+    src = ("import jax.numpy as jnp\n"
+           "from jax import lax\n"
+           "import importlib\n"
+           "m = importlib.import_module('jax')\n"
+           "y = jnp.zeros(3)\n")
+    rules = [f.rule for f in lint_trace.lint_no_jax_source(src, "s.py")]
+    assert rules == ["no-jax"] * 4
+    # jax inside a string or comment is NOT a finding
+    assert lint_trace.lint_no_jax_source(
+        "x = 'jax'  # jax\n", "s.py") == []
+
+
+def test_no_jax_rides_the_default_lint_prong():
+    rep = runner.run_lint(None, quiet=True)
+    assert rep["ok"], rep["findings"]
+
+
+# ---------------------------------------------------------------- the CLI
+
+
+def test_runner_index_prong_exit_codes(capsys):
+    rc = runner.main(["--index", "--index-engine", "async",
+                      "--max-states", "128",
+                      "--skip-model-check", "--skip-lint"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "index audit: ok" in out
+    assert "indices/instr" in out
+    rc = runner.main(["--index", "--skip-model-check", "--skip-lint",
+                      "--mutation", "split_packed_scatter"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "index_budget" in out
+    assert "merge candidate" in out
+
+
+def test_runner_index_prong_budget_exhaustion(capsys):
+    """A probe that cannot quiesce inside --max-states is exit 3
+    (inconclusive), not a fake pass or fail."""
+    rc = runner.main(["--index", "--index-engine", "async",
+                      "--max-states", "1",
+                      "--skip-model-check", "--skip-lint"])
+    assert rc == 3
+
+
+def test_runner_rejects_index_mutation_elsewhere():
+    with pytest.raises(SystemExit, match="index mutation"):
+        runner.main(["--skip-lint", "--mutation",
+                     "split_packed_scatter"])
+
+
+def test_index_row_for_perf_report():
+    row = ic.index_row("async", 8)
+    assert row["target"] == "step.cycle"
+    assert row["index_sites"] == ic.INDEX_BUDGETS["step.cycle"]
+    assert row["indices_per_step"] \
+        == sum(row["by_plane"].values())
+    json.dumps(row)     # must embed into the --json perf report as-is

@@ -1,0 +1,717 @@
+"""Index-pressure auditor: static gather/scatter attribution per engine.
+
+PERF.md calls the engines *index-bound* and, until this module, backed
+that with one hand count ("~9 scatter/gather indices per retired
+instruction") that no tool derived, tracked, or gated. This is the
+seventh analyze prong (``cache-sim analyze --index``): it traces every
+hot body with ``jax.make_jaxpr`` — the async cycle and its scan
+runner, the wave chunk the daemon drives, the sync and deep rounds,
+the fused Pallas round body, the sharded/RDMA parallel variants — and
+walks the closed jaxprs for every *index equation* (``gather``,
+``scatter*``, ``dynamic_slice``, ``dynamic_update_slice``), recording:
+
+* **shape inventory** — operand / index-vector / update shapes, plus a
+  trip weight (product of enclosing ``scan`` lengths) so an index op
+  inside the deep round's drain folds counts once per executed
+  iteration, not once per source line;
+* **plane attribution** — each op's array operand is walked back
+  through the producing equations to the state leaves that feed it
+  (operand-0 chains through scatters/reshapes/converts, unions at
+  genuine fan-in), and the root names map onto the semantic planes:
+  cache / directory / mailbox / arbitration / telemetry / frontend /
+  window;
+* **indices per retired instruction** — a small deterministic probe
+  run per engine (uniform workload, fixed seed) pins (steps, retired),
+  and the hot body's weighted index count per step divides through:
+  the machine-checked replacement for PERF.md's hand estimate;
+* **mergeable-scatter candidates** — scatter pairs in the same scope
+  whose index operands have identical *structural signatures* (the
+  producing sub-DAG hashed down to input names and literals — var
+  names never enter, so the signature is stable across traces) but
+  pairwise-disjoint destination roots: exactly the shape PR 8
+  consolidated by hand (five per-plane scatters sharing one index
+  vector -> two packed row scatters, -55.56% median). The detector
+  emits the next consolidation worklist instead of a reading session.
+
+Per-target ceilings live in :data:`INDEX_BUDGETS` (index *sites*, not
+weighted indices — stable across N and loop lengths) and are enforced
+both here and in the always-on ``--jaxpr`` prong (analysis/lint_jaxpr),
+so index-traffic regressions fail CI exactly like eqn-count and
+bytes/instr regressions do. The seeded mutation
+``INDEX_MUTATIONS.split_packed_scatter`` (analysis/mutations.py) flips
+``ops.step._PACKED_COMMIT`` to the bit-identical de-consolidated
+commit: every dynamic oracle stays green and only this prong — budget
+breach plus merge candidates naming the re-split planes — can see it.
+
+House pattern per analysis/kernelcheck.py: ``check()`` returns a
+findings-aggregated dict under :data:`SCHEMA`, ``render_text`` the
+human report, exit codes ride ``cache-sim analyze``'s 0/1/3 contract
+(the probe hitting its cycle budget before quiescence is the prong's
+"budget exhausted, nothing proven" case).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Dict, List, Optional, Sequence
+
+import jax
+
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.state import init_state
+
+SCHEMA = "cache-sim/indexcheck/v1"
+
+#: engines the auditor covers; ``async`` additionally carries the
+#: parallel variants (sharded cycle on a 1-device mesh, RDMA router)
+ENGINES = ("async", "sync", "deep", "wave", "fused")
+
+#: canonical audit size: budgets are pinned at this node count (index
+#: *sites* are N-independent in the vectorized design — audited by
+#: test_indexcheck — so the pin holds at any N; the report still notes
+#: when a non-default N was used)
+DEFAULT_NODES = 8
+
+#: per-target index-SITE ceilings, pinned to the measured shipped
+#: counts (exact: any new gather/scatter/dynamic-slice site fails CI;
+#: regenerate deliberately when index traffic changes on purpose).
+#: Shared with analysis/lint_jaxpr's always-on --jaxpr prong for the
+#: targets both walk.  Pinned at ``inv_mode="scatter"`` (the scale
+#: configs the auditor traces); resolve through :func:`index_budget`
+#: when the traced config may differ.
+INDEX_BUDGETS = {
+    "step.cycle": 27,
+    "step.run_cycles[8]": 27,
+    "step.run_wave_chunk[2x4]": 27,
+    "sync_engine.round_step": 7,
+    "sync_engine.round_step[deep]": 9,
+    "pallas_round.round_body": 8,
+    "rdma_comm.route": 9,
+    "parallel.sharded_cycle": 27,
+}
+
+#: sites are N-independent (the vectorized design indexes whole
+#: planes) but NOT inv_mode-independent: ``inv_mode="mailbox"`` (the
+#: reference config lint_jaxpr audits at) replaces the async cycle's
+#: scatter-based invalidation fan-out with mailbox enqueues, which
+#: costs 2 fewer index sites per cycle trace.  Measured deltas, same
+#: exact-pin discipline as the table above.
+_MAILBOX_DELTA = {
+    "step.cycle": -2,
+    "step.run_cycles[8]": -2,
+    "step.run_wave_chunk[2x4]": -2,
+    "parallel.sharded_cycle": -2,
+}
+
+
+def index_budget(target: str, inv_mode: str = "scatter"):
+    """Pinned index-site count for ``target`` under ``inv_mode``, or
+    None when the target has no pin."""
+    b = INDEX_BUDGETS.get(target)
+    if b is not None and inv_mode == "mailbox":
+        b += _MAILBOX_DELTA.get(target, 0)
+    return b
+
+_INDEX_PRIMS = ("gather", "dynamic_slice", "dynamic_update_slice")
+
+#: operand-0 passthrough primitives for the provenance walk: the
+#: output *is* (a view/rewrite of) the first operand
+_CHAIN_PRIMS = ("convert_element_type", "bitcast_convert_type",
+                "reshape", "transpose", "copy", "squeeze", "rev",
+                "slice", "expand_dims", "gather", "dynamic_slice",
+                "dynamic_update_slice")
+
+_PLANE_EXACT = {
+    "memory": "directory", "dir_state": "directory",
+    "dir_bitvec": "directory", "dm": "directory", "dm0": "directory",
+    "arb_rank": "arbitration", "order_rank": "arbitration",
+    "seed": "arbitration", "issue_delay": "arbitration",
+    "issue_period": "arbitration",
+    "hor": "window", "horizon": "window",
+}
+
+_PLANE_PREFIX = (
+    ("cache", "cache"), ("ca_t", "cache"), ("cv_t", "cache"),
+    ("cs_t", "cache"),
+    ("mb_", "mailbox"), ("msg", "mailbox"),
+    ("metrics", "telemetry"), ("obs", "telemetry"),
+    ("lat", "telemetry"),
+    ("instr", "frontend"), ("cur_", "frontend"), ("idx", "frontend"),
+    ("waiting", "frontend"),
+    ("w_", "window"),
+)
+
+
+def _is_index(name: str) -> bool:
+    return name in _INDEX_PRIMS or name.startswith("scatter")
+
+
+def _subjaxprs(v):
+    vs = v if isinstance(v, (list, tuple)) else [v]
+    for s in vs:
+        if hasattr(s, "jaxpr"):        # ClosedJaxpr
+            yield s.jaxpr
+        elif hasattr(s, "eqns"):       # raw Jaxpr
+            yield s
+
+
+def plane_of(root: str) -> str:
+    head = root.lstrip(".").split(".", 1)[0].split("[", 1)[0]
+    if head in _PLANE_EXACT:
+        return _PLANE_EXACT[head]
+    for prefix, plane in _PLANE_PREFIX:
+        if head.startswith(prefix):
+            return plane
+    return "other"
+
+
+def leaf_names(*trees) -> List[str]:
+    """Flattened leaf names of pytree args, in ``make_jaxpr`` invar
+    order (jax.tree_util paths; '.metrics.cycles' -> 'metrics.cycles')."""
+    names: List[str] = []
+    for t in trees:
+        for path, _ in jax.tree_util.tree_flatten_with_path(t)[0]:
+            nm = jax.tree_util.keystr(path).lstrip(".") or "arg"
+            names.append(nm)
+    return names
+
+
+def _shape_str(aval) -> str:
+    dt = getattr(aval, "dtype", None)
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return "?"
+    short = {"int32": "i32", "uint32": "u32", "int8": "i8",
+             "uint8": "u8", "bool": "b1", "float32": "f32",
+             "int16": "i16", "uint16": "u16"}.get(str(dt), str(dt))
+    return f"{short}[{','.join(str(d) for d in shape)}]"
+
+
+def _index_vectors(eqn) -> int:
+    """Number of index vectors one execution of this eqn consumes."""
+    name = eqn.primitive.name
+    if name == "gather" or name.startswith("scatter"):
+        shape = getattr(eqn.invars[1].aval, "shape", ())
+        n = 1
+        for d in shape[:-1]:
+            n *= int(d)
+        return n if shape else 1
+    return 1   # dynamic_slice / dynamic_update_slice: one start tuple
+
+
+class _Scope:
+    """One (sub)jaxpr under the walk: producer map, invar names, memo
+    tables for provenance roots and structural signatures."""
+
+    def __init__(self, jaxpr, names: Sequence[str], label: str):
+        self.jaxpr = jaxpr
+        self.label = label
+        self.names: Dict[object, str] = {}
+        for v, nm in zip(jaxpr.invars, names):
+            self.names[v] = nm
+        for v in jaxpr.constvars:
+            self.names[v] = "const"
+        self.prod: Dict[object, tuple] = {}
+        for eqn in jaxpr.eqns:
+            for pos, ov in enumerate(eqn.outvars):
+                self.prod[ov] = (eqn, pos)
+        self._roots: Dict[object, frozenset] = {}
+        self._sigs: Dict[object, tuple] = {}
+        self._anchors: Dict[object, int] = {}
+
+    # -- provenance --------------------------------------------------------
+    def roots(self, v, depth: int = 0) -> frozenset:
+        from jax.core import Literal
+        if isinstance(v, Literal):
+            return frozenset()
+        if v in self.names:
+            return frozenset([self.names[v]])
+        got = self._roots.get(v)
+        if got is not None:
+            return got
+        self._roots[v] = frozenset(["..."])   # cycle/depth guard
+        out: frozenset
+        if depth > 64 or v not in self.prod:
+            out = frozenset(["?"])
+        else:
+            eqn, _ = self.prod[v]
+            prim = eqn.primitive.name
+            if prim in _CHAIN_PRIMS or prim.startswith("scatter"):
+                out = self.roots(eqn.invars[0], depth + 1)
+            else:
+                ins = eqn.invars
+                if prim == "select_n" and len(ins) > 1:
+                    ins = ins[1:]       # predicate origins are noise
+                acc = frozenset()
+                for iv in ins:
+                    acc = acc | self.roots(iv, depth + 1)
+                out = acc
+        self._roots[v] = out
+        return out
+
+    def root_label(self, v, limit: int = 4) -> str:
+        rs = sorted(self.roots(v))
+        if len(rs) > limit:
+            rs = rs[:limit] + ["..."]
+        return "+".join(rs) if rs else "lit"
+
+    def planes(self, v) -> List[str]:
+        ps = sorted({plane_of(r) for r in self.roots(v)
+                     if r not in ("...", "?", "const", "lit")})
+        if len(ps) > 3:
+            return ["mixed"]       # genuine fan-in of most of the state
+        return ps or ["other"]
+
+    # -- destination anchoring --------------------------------------------
+    def dest_token(self, v) -> str:
+        """Deterministic identity of a scatter's destination array:
+        follow operand-0 chains to the terminal var (a state leaf, a
+        constvar, or a freshly built buffer) and label it by root name
+        plus first-appearance ordinal — chained scatters into one
+        array share a token; distinct buffers never do. Var names/ids
+        never enter the label."""
+        from jax.core import Literal
+        seen = 0
+        while not isinstance(v, Literal) and v not in self.names \
+                and v in self.prod and seen < 256:
+            eqn, _ = self.prod[v]
+            prim = eqn.primitive.name
+            if not (prim in _CHAIN_PRIMS or prim.startswith("scatter")):
+                break
+            v = eqn.invars[0]
+            seen += 1
+        if isinstance(v, Literal):
+            base = "lit"
+        elif v in self.names:
+            base = self.names[v]
+        else:
+            base = self.root_label(v)
+        key = v if not isinstance(v, Literal) else repr(v.val)
+        ordinal = self._anchors.get(key)
+        if ordinal is None:
+            ordinal = len(self._anchors)
+            self._anchors[key] = ordinal
+        return f"{base}#{ordinal}"
+
+    # -- structural index signature ---------------------------------------
+    def sig_hash(self, v) -> str:
+        """Merkle hash of the producing sub-DAG: per-node digest over
+        (primitive, out position, non-jaxpr params, child digests),
+        bottoming out at input NAMES and literal values — jaxpr var
+        names never enter, so the signature is identical across
+        retraces; memoized per var, so shared subexpressions hash once
+        (linear in DAG size)."""
+        from jax.core import Literal
+
+        def h(parts) -> str:
+            return hashlib.sha256(
+                "\x1f".join(parts).encode()).hexdigest()[:12]
+
+        def rec(x, depth: int) -> str:
+            if isinstance(x, Literal):
+                return h(["lit", repr(x.val)])
+            if x in self.names:
+                return h(["in", self.names[x]])
+            got = self._sigs.get(x)
+            if got is not None:
+                return got
+            self._sigs[x] = h(["cyc"])
+            if depth > 512 or x not in self.prod:
+                out = h(["free", _shape_str(x.aval)])
+            else:
+                eqn, pos = self.prod[x]
+                parts = [eqn.primitive.name, str(pos)]
+                for k in sorted(eqn.params):
+                    pv = eqn.params[k]
+                    parts.append(k)
+                    parts.append("<jaxpr>" if list(_subjaxprs(pv))
+                                 else repr(pv))
+                parts.extend(rec(iv, depth + 1) for iv in eqn.invars)
+                out = h(parts)
+            self._sigs[x] = out
+            return out
+
+        return rec(v, 0)
+
+
+def inventory(closed, invar_names: Sequence[str],
+              target: str) -> List[dict]:
+    """Walk one closed jaxpr; returns the ordered list of index-op
+    records (no jaxpr var names anywhere — byte-stable across traces)."""
+    ops: List[dict] = []
+    scopes = 0
+
+    def walk(jaxpr, names, label, weight):
+        nonlocal scopes
+        sc = _Scope(jaxpr, names, label)
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            if _is_index(prim):
+                rec = {
+                    "primitive": prim,
+                    "scope": label,
+                    "plane": "+".join(sc.planes(eqn.invars[0])),
+                    "operand": _shape_str(eqn.invars[0].aval),
+                    "trip_weight": weight,
+                    "indices": _index_vectors(eqn) * weight,
+                }
+                if prim == "gather" or prim.startswith("scatter"):
+                    rec["index_shape"] = _shape_str(eqn.invars[1].aval)
+                    rec["index_sig"] = sc.sig_hash(eqn.invars[1])
+                if prim.startswith("scatter"):
+                    rec["update"] = _shape_str(eqn.invars[2].aval)
+                    rec["roots"] = sorted(sc.roots(eqn.invars[0]))[:6]
+                    rec["dest"] = sc.dest_token(eqn.invars[0])
+                elif prim == "dynamic_update_slice":
+                    rec["update"] = _shape_str(eqn.invars[1].aval)
+                ops.append(rec)
+            for pv in eqn.params.values():
+                subs = list(_subjaxprs(pv))
+                if not subs:
+                    continue
+                w = weight
+                if prim == "scan":
+                    w = weight * int(eqn.params.get("length", 1))
+                for sub in subs:
+                    scopes += 1
+                    k = len(sub.invars)
+                    tail = eqn.invars[-k:] if k else []
+                    sub_names = [sc.root_label(iv) for iv in tail]
+                    sub_names += ["arg"] * (k - len(sub_names))
+                    walk(sub, sub_names, f"{label}/{prim}{scopes}", w)
+
+    walk(closed.jaxpr, list(invar_names), target, 1)
+    return ops
+
+
+def count_index_sites(jaxpr) -> int:
+    """Flattened count of index equations (unweighted sites) — the
+    quantity :data:`INDEX_BUDGETS` bounds; used by lint_jaxpr too."""
+    n = 0
+    stack = [jaxpr]
+    while stack:
+        j = stack.pop()
+        for eqn in j.eqns:
+            if _is_index(eqn.primitive.name):
+                n += 1
+            for v in eqn.params.values():
+                stack.extend(_subjaxprs(v))
+    return n
+
+
+def merge_candidates(ops: List[dict]) -> List[dict]:
+    """Scatter pairs sharing one structural index signature in one
+    scope, writing pairwise-disjoint destination roots: pack the
+    planes and commit one row scatter (the PR-8 consolidation shape).
+    Chained scatters into the same array share roots and are excluded
+    (a chain is already one logical write stream, not a merge)."""
+    groups: Dict[tuple, List[dict]] = {}
+    for rec in ops:
+        if not rec["primitive"].startswith("scatter"):
+            continue
+        key = (rec["scope"], rec.get("index_sig"), rec.get("update"))
+        groups.setdefault(key, []).append(rec)
+    out = []
+    for (scope, sig, update), members in sorted(groups.items()):
+        if sig is None or len(members) < 2:
+            continue
+        kept, seen_dests = [], set()
+        for m in members:
+            dest = m.get("dest", "?")
+            if dest in seen_dests:
+                continue              # chained write into the same dest
+            seen_dests.add(dest)
+            kept.append(m)
+        if len(kept) < 2:
+            continue
+        planes = sorted({m["plane"] for m in kept})
+        dests = sorted(m.get("dest", "?") for m in kept)
+        out.append({
+            "kind": "merge_candidate", "scope": scope,
+            "index_sig": sig, "count": len(kept),
+            "planes": planes, "dests": dests, "update": update,
+            "detail": (f"{len(kept)} scatters in {scope} share index "
+                       f"sig {sig} with disjoint dests "
+                       f"[{', '.join(dests)}] — pack the planes and "
+                       f"commit one row scatter (PR-8 shape)"),
+        })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# engine targets + probes
+# ---------------------------------------------------------------------------
+
+def _unjitted(fn):
+    return getattr(fn, "__wrapped__", fn)
+
+
+def engine_config(engine: str, nodes: int) -> SystemConfig:
+    if engine in ("async", "wave"):
+        return SystemConfig.scale(num_nodes=nodes)
+    if engine == "sync":
+        return SystemConfig.scale(num_nodes=nodes, drain_depth=4,
+                                  txn_width=3)
+    # deep / fused: the lint_jaxpr probe family (valid at small N)
+    return dataclasses.replace(
+        SystemConfig.scale(num_nodes=nodes, drain_depth=2,
+                           txn_width=2),
+        deep_window=True, deep_slots=4, deep_ownerval_slots=2)
+
+
+def _trivial_traces(cfg):
+    return [[(0, 1, 0)]] * cfg.num_nodes
+
+
+def trace_targets(engine: str, nodes: int) -> Dict[str, tuple]:
+    """name -> (closed_jaxpr, invar_names) for one engine. Jitted
+    entry points are traced through their unjitted bodies so a seeded
+    mutation (fresh module-flag state) is always visible — jit trace
+    caches would otherwise pin whichever variant traced first."""
+    from ue22cs343bb1_openmp_assignment_tpu import state as state_mod
+    from ue22cs343bb1_openmp_assignment_tpu.ops import step
+
+    cfg = engine_config(engine, nodes)
+    out: Dict[str, tuple] = {}
+
+    if engine == "async":
+        st = init_state(cfg, _trivial_traces(cfg))
+        names = leaf_names(st)
+        out["step.cycle"] = (
+            jax.make_jaxpr(lambda s: step.cycle(cfg, s))(st), names)
+        run_cycles = _unjitted(step.run_cycles)
+        out["step.run_cycles[8]"] = (
+            jax.make_jaxpr(lambda s: run_cycles(cfg, s, 8))(st), names)
+        out.update(_parallel_targets(cfg, st, names))
+    elif engine == "wave":
+        st = init_state(cfg, _trivial_traces(cfg))
+        b = state_mod.stack_states([st, init_state(cfg)])
+        out["step.run_wave_chunk[2x4]"] = (
+            jax.make_jaxpr(
+                lambda s: step.batched_wave_chunk(cfg, s, 4, 64))(b),
+            leaf_names(b))
+    elif engine in ("sync", "deep"):
+        from ue22cs343bb1_openmp_assignment_tpu.ops import (
+            sync_engine as se)
+        sst = se.from_sim_state(cfg, init_state(cfg,
+                                                _trivial_traces(cfg)))
+        name = ("sync_engine.round_step" if engine == "sync"
+                else "sync_engine.round_step[deep]")
+        out[name] = (
+            jax.make_jaxpr(lambda s: se.round_step(cfg, s))(sst),
+            leaf_names(sst))
+    elif engine == "fused":
+        from ue22cs343bb1_openmp_assignment_tpu.analysis import (
+            kernelcheck)
+        out["pallas_round.round_body"] = (
+            kernelcheck.trace_round_body(cfg),
+            ["params", "dm0", "ca_t", "cv_t", "cs_t", "w_oa", "w_val",
+             "w_live", "hor"])
+    else:
+        raise ValueError(f"unknown engine {engine!r}")
+    return out
+
+
+def _parallel_targets(cfg, st, names):
+    """The parallel variants ride the async engine: the GSPMD-sharded
+    cycle on a 1-device mesh (fresh jit wrapper per call — no shared
+    trace cache) and the RDMA lane router in interpret mode."""
+    import jax.numpy as jnp
+
+    from ue22cs343bb1_openmp_assignment_tpu.parallel import (
+        mesh as pmesh, rdma_comm, sharded_step)
+
+    mesh = pmesh.make_mesh(jax.devices()[:1])
+    f = sharded_step.make_sharded_cycle(cfg, mesh, st)
+    out = {"parallel.sharded_cycle": (jax.make_jaxpr(f)(st), names)}
+
+    router = rdma_comm.make_rdma_router(cfg, mesh, interpret=True)
+    N, S, Fw = cfg.num_nodes, cfg.out_slots, 6 + cfg.msg_bitvec_words
+    ctype = jnp.ones((N, S), jnp.int32)
+    recv = jnp.tile(jnp.arange(N, dtype=jnp.int32)[:, None], (1, S))
+    prio = jnp.arange(N * S, dtype=jnp.int32).reshape(N, S)
+    fields = jnp.zeros((N, S, Fw), jnp.int32)
+    out["rdma_comm.route"] = (
+        jax.make_jaxpr(router)(ctype, recv, prio, fields),
+        ["msg_type", "msg_recv", "msg_prio", "msg_fields"])
+    return out
+
+
+#: the hot body whose per-step index count defines each engine's
+#: indices/instr headline
+HOT_BODY = {
+    "async": "step.cycle",
+    "wave": "step.run_wave_chunk[2x4]",
+    "sync": "sync_engine.round_step",
+    "deep": "sync_engine.round_step[deep]",
+    "fused": "pallas_round.round_body",
+}
+
+
+def _probe(engine: str, nodes: int, budget: int) -> dict:
+    """One deterministic small run (uniform workload, seed 0): pins
+    (steps, retired, quiesced) for the indices/instr denominator."""
+    import jax.numpy as jnp
+
+    from ue22cs343bb1_openmp_assignment_tpu import state as state_mod
+    from ue22cs343bb1_openmp_assignment_tpu.models.system import (
+        CoherenceSystem)
+    from ue22cs343bb1_openmp_assignment_tpu.ops import step
+
+    cfg = engine_config(engine, nodes)
+    sys_ = CoherenceSystem.from_workload(cfg, "uniform", trace_len=16,
+                                         seed=0)
+    if engine == "async":
+        final = step.run_to_quiescence(cfg, sys_.state, budget)
+        return {"steps": int(final.cycle),
+                "retired": int(final.metrics.instrs_retired),
+                "quiesced": bool(final.quiescent())}
+    if engine == "wave":
+        other = CoherenceSystem.from_workload(cfg, "uniform",
+                                              trace_len=16, seed=1)
+        b = state_mod.stack_states([sys_.state, other.state])
+        chunks, done = 0, False
+        while not done and chunks * 4 < budget:
+            b, quiet, done_v = step.run_wave_chunk(cfg, b, 4, budget)
+            done = bool(jnp.all(done_v))
+            chunks += 1
+        return {"steps": chunks,
+                "retired": int(jnp.sum(b.metrics.instrs_retired)),
+                "quiesced": done}
+    # sync / deep / fused share the round engine's retire rate (the
+    # fused body IS one deep round; its probe run uses the same core)
+    from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+    sst = se.from_sim_state(cfg, sys_.state)
+    out = se.run_sync_to_quiescence(cfg, sst, chunk=8,
+                                    max_rounds=max(budget, 8))
+    rounds = int(out.round)
+    return {"steps": rounds,
+            "retired": int(out.metrics.instrs_retired),
+            "quiesced": rounds < max(budget, 8)}
+
+
+# ---------------------------------------------------------------------------
+# the prong
+# ---------------------------------------------------------------------------
+
+def check(engines: Optional[Sequence[str]] = None,
+          nodes: int = DEFAULT_NODES, probe: bool = True,
+          probe_budget: int = 4096) -> dict:
+    """Run the audit; returns the findings-aggregated report dict."""
+    engines = list(ENGINES) if engines is None else list(engines)
+    findings: List[dict] = []
+    exhausted = False
+    eng_out: Dict[str, dict] = {}
+    cross: Dict[str, Dict[str, int]] = {}
+
+    for engine in engines:
+        targets = {}
+        candidates: List[dict] = []
+        for name, (closed, invar_names) in \
+                trace_targets(engine, nodes).items():
+            ops = inventory(closed, invar_names, name)
+            sites = count_index_sites(closed.jaxpr)
+            by_plane: Dict[str, dict] = {}
+            for rec in ops:
+                row = by_plane.setdefault(rec["plane"],
+                                          {"ops": 0, "indices": 0})
+                row["ops"] += 1
+                row["indices"] += rec["indices"]
+            cands = merge_candidates(ops)
+            candidates.extend(cands)
+            targets[name] = {
+                "index_sites": sites,
+                "indices_per_call": sum(r["indices"] for r in ops),
+                "by_plane": by_plane,
+                "ops": ops,
+            }
+            budget = INDEX_BUDGETS.get(name)
+            if budget is not None and nodes == DEFAULT_NODES \
+                    and sites > budget:
+                findings.append({
+                    "pass": "budget", "kind": "index_budget",
+                    "target": name,
+                    "detail": f"{sites} index sites > budget {budget} "
+                              f"(gather/scatter/dynamic-slice eqns; "
+                              f"INDEX_BUDGETS pins the shipped count "
+                              f"exactly)"})
+        hot = HOT_BODY[engine]
+        per_step = targets[hot]["indices_per_call"] if hot in targets \
+            else 0
+        rec = {"config": {"num_nodes": nodes}, "targets": targets,
+               "merge_candidates": candidates,
+               "hot_body": hot, "indices_per_step": per_step,
+               "probe": None, "indices_per_instr": None}
+        if probe:
+            pr = _probe(engine, nodes, probe_budget)
+            rec["probe"] = pr
+            if not pr["quiesced"]:
+                exhausted = True
+            elif pr["retired"]:
+                rec["indices_per_instr"] = round(
+                    per_step * pr["steps"] / pr["retired"], 3)
+        for plane, row in targets.get(hot, {}).get("by_plane",
+                                                   {}).items():
+            cross.setdefault(plane, {})[engine] = row["indices"]
+        eng_out[engine] = rec
+
+    return {"schema": SCHEMA, "nodes": nodes,
+            "default_nodes": DEFAULT_NODES,
+            "budgets": {k: INDEX_BUDGETS[k]
+                        for k in sorted(INDEX_BUDGETS)},
+            "budgets_enforced": nodes == DEFAULT_NODES,
+            "engines": eng_out, "cross_engine": cross,
+            "findings": findings, "budget_exhausted": exhausted,
+            "ok": not findings}
+
+
+def render_text(rep: dict) -> List[str]:
+    verdict = "ok" if rep["ok"] else "FAIL"
+    if rep["ok"] and rep.get("budget_exhausted"):
+        verdict = "BUDGET EXHAUSTED (probe never quiesced — not a pass)"
+    lines = [f"== index audit: {verdict} [N={rep['nodes']}, "
+             f"engines: {', '.join(rep['engines'])}]"]
+    for engine, er in rep["engines"].items():
+        ipi = er["indices_per_instr"]
+        ipi_s = "n/a" if ipi is None else f"{ipi:.3f}"
+        pr = er.get("probe") or {}
+        lines.append(
+            f"   {engine}: {er['indices_per_step']} indices/step "
+            f"({er['hot_body']}), {ipi_s} indices/instr"
+            + (f" [{pr['steps']} steps, {pr['retired']} retired]"
+               if pr else ""))
+        for name, t in er["targets"].items():
+            planes = ", ".join(
+                f"{p}={v['indices']}" for p, v in
+                sorted(t["by_plane"].items()))
+            lines.append(f"      {name}: {t['index_sites']} sites, "
+                         f"{t['indices_per_call']} indices/call "
+                         f"[{planes}]")
+        for c in er["merge_candidates"]:
+            lines.append(f"   ~ merge candidate: {c['detail']}")
+        if not er["merge_candidates"]:
+            lines.append(f"   {engine}: no mergeable-scatter pairs "
+                         "under the shared-index/disjoint-dest "
+                         "pattern")
+    for f in rep["findings"]:
+        lines.append(f"  ! {f['pass']}/{f['kind']}: "
+                     f"[{f.get('target', '?')}] {f['detail']}")
+    return lines
+
+
+def index_row(engine: str = "async",
+              nodes: int = DEFAULT_NODES) -> dict:
+    """The deterministic perf-report block (obs/cli embeds this as
+    doc['index']; obs/roofline renders it): the hot body's static
+    per-step inventory plus plane split — no probe run, the perf
+    report already pins (steps, retired) from its own measured run."""
+    rep = check(engines=[engine], nodes=nodes, probe=False)
+    er = rep["engines"][engine]
+    hot = er["hot_body"]
+    return {"engine": engine, "target": hot, "nodes": nodes,
+            "indices_per_step": er["indices_per_step"],
+            "index_sites": er["targets"][hot]["index_sites"],
+            "by_plane": {p: v["indices"] for p, v in
+                         er["targets"][hot]["by_plane"].items()},
+            "merge_candidates": len(er["merge_candidates"])}

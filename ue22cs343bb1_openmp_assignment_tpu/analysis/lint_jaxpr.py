@@ -19,6 +19,14 @@ explicit:
   trips the budget long before it trips a wall-clock alarm.
 * ``host_callback`` — no host round-trips (``*callback*``, infeed /
   outfeed) inside the hot path.
+* ``index_budget`` — the flattened count of index equations (gather /
+  scatter* / dynamic_slice / dynamic_update_slice) per target stays at
+  the exact shipped count pinned in
+  ``analysis.indexcheck.INDEX_BUDGETS`` — the engines are index-bound
+  (PERF.md), so a new index site is a perf regression CI must see even
+  when every dynamic oracle stays green. ``cache-sim analyze --index``
+  is the full auditor (plane attribution, indices/instr, merge
+  detection); this rule is its always-on tripwire.
 
 :func:`recompile_guard` additionally asserts repeated same-shape calls
 hit the trace cache on all three engines: fresh ``jax.jit`` wrappers
@@ -46,8 +54,13 @@ EQN_BUDGET = 2048
 #: scatter-min ladder, window fold — measured ~36k flattened eqns at
 #: the N=8 probe config and nearly N-independent (the routed ops are
 #: matmuls, not unrolled loops); 65536 bounds it while still tripping
-#: on any per-node unrolling (which would multiply the count by N)
-EQN_BUDGETS = {"pallas_round.round_body": 65536}
+#: on any per-node unrolling (which would multiply the count by N).
+#: The daemon's wave chunk wraps the cycle in batch stacking + the
+#: masked chunk scan — measured ~1.5k flattened eqns, comfortably
+#: under the shared 2048 ceiling, so it rides the default; the entry
+#: here is the explicit first-class pin PR 15 left implicit.
+EQN_BUDGETS = {"pallas_round.round_body": 65536,
+               "step.run_wave_chunk[2x4]": 2048}
 
 _WIDE = ("int64", "uint64", "float64")
 _HOST_PRIMS = ("infeed", "outfeed")
@@ -112,10 +125,31 @@ def _targets(cfg: SystemConfig) -> dict:
             lambda s: step.run_cycles_ledger(cfg, s, 8, None, True),
         "step.run_to_quiescence":
             lambda s: step.run_to_quiescence(cfg, s, 64),
+        # the daemon's hot body (PR 15): one masked chunk of batched
+        # wave cycles over a 2-job stacked batch, traced through the
+        # unjitted core so the audit never depends on a shared jit
+        # trace cache
+        "step.run_wave_chunk[2x4]": _wave_chunk_target(cfg),
         "pallas_round.routed_ops": lambda s: _routed_ops_probe(),
         "pallas_round.round_body": lambda s: _round_body_probe(),
         "rdma_comm.route": lambda s: _rdma_route_probe(),
     }
+
+
+def _wave_chunk_target(cfg):
+    """Target for one chunk (4 masked batched cycles) of the daemon
+    serving loop over a stacked batch of two jobs — a loaded one and an
+    idle one, prebuilt OUTSIDE the trace so the jaxpr is exactly the
+    chunk body (the same trace analysis/indexcheck audits, so the index
+    pin is shared verbatim).  ``batched_wave_chunk`` is the unjitted
+    core ``run_wave_chunk`` wraps, so the trace is fresh per lint run
+    and the per-chunk retire mask, fuel accounting and vmapped cycle
+    all face the budget rules."""
+    from ue22cs343bb1_openmp_assignment_tpu.ops import step
+    from ue22cs343bb1_openmp_assignment_tpu.state import stack_states
+    b = stack_states(
+        [init_state(cfg, [[(0, 1, 0)]] * cfg.num_nodes), init_state(cfg)])
+    return (lambda bb: step.batched_wave_chunk(cfg, bb, 4, 64), b)
 
 
 def _routed_ops_probe():
@@ -199,22 +233,48 @@ def lint(cfg: Optional[SystemConfig] = None,
          message_phase: Optional[Callable] = None) -> dict:
     """Trace and audit every hot-path target; returns {targets:
     {name: eqn_count}, findings: [...], budget, ok}."""
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import indexcheck
+
     cfg = cfg or SystemConfig.reference()
     st = init_state(cfg, [[(0, 1, 0)]] * cfg.num_nodes)
     findings: List[dict] = []
     counts = {}
+    index_sites = {}
     for name, fn in _targets(cfg).items():
-        closed = jax.make_jaxpr(fn)(st)
+        # a target is either a callable traced over the shared state or
+        # a (callable, example-arg) pair with its own prebuilt input
+        f, arg = fn if isinstance(fn, tuple) else (fn, st)
+        closed = jax.make_jaxpr(f)(arg)
         counts[name] = _audit(closed.jaxpr, name, findings)
         budget = EQN_BUDGETS.get(name, EQN_BUDGET)
         if counts[name] > budget:
             findings.append({
                 "target": name, "rule": "primitive_budget",
                 "detail": f"{counts[name]} eqns > budget {budget}"})
+        # index sites are N-independent (the vectorized design indexes
+        # whole planes), so the counts the index auditor pins at its
+        # canonical size hold at the lint config too — modulo the
+        # reference config's mailbox inv_mode, which index_budget()
+        # accounts for
+        ibudget = indexcheck.index_budget(name, cfg.inv_mode)
+        if ibudget is not None:
+            sites = indexcheck.count_index_sites(closed.jaxpr)
+            index_sites[name] = sites
+            if sites != ibudget:
+                findings.append({
+                    "target": name, "rule": "index_budget",
+                    "detail": (f"{sites} index sites != pinned {ibudget}"
+                               " (gather/scatter/dynamic-slice; run"
+                               " `cache-sim analyze --index` for the"
+                               " plane-attributed inventory, then"
+                               " re-pin analysis/indexcheck."
+                               "INDEX_BUDGETS if intended)")})
     return {"schema": "cache-sim/jaxpr-lint/v1",
             "num_nodes": cfg.num_nodes, "budget": EQN_BUDGET,
             "budget_overrides": dict(EQN_BUDGETS),
-            "targets": counts, "findings": findings,
+            "index_budgets": dict(indexcheck.INDEX_BUDGETS),
+            "targets": counts, "index_sites": index_sites,
+            "findings": findings,
             "ok": not findings}
 
 

@@ -45,8 +45,17 @@ the docstring, or a ``# lint: host`` comment on the ``def`` line or
 the line above. The escape hatch is visible in the diff, which is the
 point.
 
+A separate boundary pass, ``no-jax``, guards the opposite contract:
+the daemon's wire layer (``daemon/server.py``, ``daemon/client.py``)
+must stay importable on machines with no accelerator stack — socket +
+json only, jax reaches the process solely through the worker the
+server spawns. Any ``import jax``/``jaxlib``, any ``jax``/``jnp`` name
+reference, or an ``importlib.import_module("jax...")`` in those files
+is a finding.
+
 Public API: :func:`lint_source` (unit tests), :func:`lint_file`,
-:func:`lint_paths`, :func:`default_targets`.
+:func:`lint_paths`, :func:`default_targets`, :func:`lint_no_jax`
+(and :func:`lint_no_jax_source` for unit tests).
 """
 
 from __future__ import annotations
@@ -540,4 +549,76 @@ def lint_paths(paths: Optional[Iterable] = None) -> List[Finding]:
         for f in files:
             findings.extend(lint_file(f))
     findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return findings
+
+
+# -- no-jax boundary lint ----------------------------------------------
+
+#: module roots banned in the daemon wire layer
+_JAX_ROOTS = {"jax", "jaxlib", "jnp"}
+
+
+def no_jax_targets() -> List[pathlib.Path]:
+    """The files that must stay jax-free: the daemon's wire layer
+    (PR 15).  A client submitting a job, or the server's admission
+    loop, must never pay jax import time or pull in the accelerator
+    stack — device work lives behind the spawned worker boundary."""
+    pkg = pathlib.Path(__file__).resolve().parents[1]
+    return [pkg / "daemon" / "server.py", pkg / "daemon" / "client.py"]
+
+
+def lint_no_jax_source(src: str,
+                       filename: str = "<string>") -> List[Finding]:
+    """Flag every route by which ``src`` could reach jax: direct
+    imports (any depth: ``import jax.numpy``, ``from jax import ...``),
+    bare ``jax``/``jnp`` name references (catches call-through on an
+    object smuggled in under those names), and literal
+    ``importlib.import_module("jax...")``."""
+    tree = ast.parse(src, filename=filename)
+    findings: List[Finding] = []
+
+    def hit(node, msg):
+        findings.append(Finding(filename, node.lineno, node.col_offset,
+                                "no-jax", "<module>", msg))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.split(".", 1)[0] in _JAX_ROOTS:
+                    hit(node, f"`import {alias.name}` in the daemon "
+                              "wire layer — socket + json only; jax "
+                              "belongs behind the worker boundary")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.level == 0 and \
+                    node.module.split(".", 1)[0] in _JAX_ROOTS:
+                hit(node, f"`from {node.module} import ...` in the "
+                          "daemon wire layer — socket + json only; jax "
+                          "belongs behind the worker boundary")
+        elif isinstance(node, ast.Name) and node.id in _JAX_ROOTS and \
+                isinstance(node.ctx, ast.Load):
+            hit(node, f"`{node.id}` referenced in the daemon wire "
+                      "layer — device work belongs behind the worker "
+                      "boundary")
+        elif isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name in ("importlib.import_module", "import_module") and \
+                    node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str) and \
+                    node.args[0].value.split(".", 1)[0] in _JAX_ROOTS:
+                hit(node, f"import_module({node.args[0].value!r}) in "
+                          "the daemon wire layer")
+    findings.sort(key=lambda f: (f.file, f.line, f.col))
+    return findings
+
+
+def lint_no_jax(paths: Optional[Iterable] = None) -> List[Finding]:
+    """Run the no-jax boundary pass over ``paths`` (default: the
+    daemon wire layer)."""
+    targets = [pathlib.Path(p) for p in paths] if paths else \
+        no_jax_targets()
+    findings: List[Finding] = []
+    for p in targets:
+        findings.extend(lint_no_jax_source(p.read_text(),
+                                           filename=str(p)))
+    findings.sort(key=lambda f: (f.file, f.line, f.col))
     return findings

@@ -337,6 +337,37 @@ KERNEL_MUTATIONS = {
 }
 
 
+@contextlib.contextmanager
+def split_packed_scatter():
+    """Re-split the round-8 packed row commits back into per-plane
+    scatters (ops/step._PACKED_COMMIT seam): cache_state/addr/val and
+    memory/dir_state/dir_bitvec each get their own gather+scatter
+    again, every split scatter sharing the family's one index vector
+    and unset columns writing back their own old value. Bit-identical
+    to the shipped packed commit — the model checker, fuzzer,
+    conformance gate and every golden dump stay green — but index
+    sites in step.cycle jump 27 -> 35 and the per-plane scatters
+    re-form exactly the shared-index/disjoint-dest pattern the merge
+    detector names. Expected: `index_budget` from the --index prong's
+    budget pass, plus merge-candidate findings listing the re-split
+    planes. Only the static index audit can see this mutant."""
+    from ue22cs343bb1_openmp_assignment_tpu.ops import step
+    old = step._PACKED_COMMIT
+    step._PACKED_COMMIT = False
+    try:
+        yield
+    finally:
+        step._PACKED_COMMIT = old
+
+
+#: name -> (context manager seeding the bug, indexcheck finding kind
+#: the --index prong must raise). Semantics-preserving by
+#: construction: killed by the static inventory alone.
+INDEX_MUTATIONS = {
+    "split_packed_scatter": (split_packed_scatter, "index_budget"),
+}
+
+
 # name -> (wrapper, scope that exposes it, finding the checker must raise)
 MUTATIONS = {
     "skip_em_bitvec_clear": (skip_em_bitvec_clear, "2n2a",

@@ -6,10 +6,13 @@ always; jaxpr IR lint + recompilation guard behind ``--jaxpr``), the
 coverage-guided differential fuzzer behind ``--fuzz N``, the
 memory-consistency litmus matrix behind ``--litmus`` (exhaustive
 outcome enumeration vs the declarative allowed sets,
-analysis/litmus.py), and the kernel-contract verifier behind
+analysis/litmus.py), the kernel-contract verifier behind
 ``--kernel`` (exact-arithmetic cap derivation, static VMEM footprint
 vs device budget, Mosaic-lowerability lint over the fused round body;
-analysis/kernelcheck.py). Prints a
+analysis/kernelcheck.py), and the index-pressure auditor behind
+``--index`` (static gather/scatter inventory with plane attribution,
+per-engine indices/instr, mergeable-scatter detection and per-target
+index budgets; analysis/indexcheck.py). Prints a
 human report that keeps reference-sanctioned quirks (`~`) visually
 distinct from genuine violations (`!`), optionally writes the full
 JSON report, and exits by the code table in ``--help``. This is the CI
@@ -30,11 +33,13 @@ exit codes — the one canonical contract for `cache-sim analyze`:
   1  findings — a protocol violation, lint finding, fuzz divergence,
      table-verification failure, table/handler conformance divergence,
      kernel-contract finding (rounding lemma, VMEM budget,
-     lowerability, or gate divergence), or failed recompilation guard
+     lowerability, or gate divergence), an index-budget breach, or a
+     failed recompilation guard
   2  usage error (argparse's code, left untouched)
   3  budget exhausted, no finding — a scope hit --max-states before
-     exhausting its state space: nothing failed, but nothing was
-     proven either; raise --max-states or shrink the scope
+     exhausting its state space, or the index prong's probe run hit
+     its cycle budget before quiescence: nothing failed, but nothing
+     was proven either; raise --max-states or shrink the scope
 findings always win: a run that both finds a violation and exhausts a
 budget exits 1, not 3.
 
@@ -132,6 +137,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "passes and the block-table VMEM row only "
                         "(~1s instead of ~15s; traced liveness peak "
                         "and lowerability scan are skipped)")
+    p.add_argument("--index", action="store_true",
+                   help="run the index-pressure prong: trace every hot "
+                        "body, inventory gather/scatter/dynamic-slice "
+                        "eqns with semantic-plane attribution, compute "
+                        "per-engine indices per retired instruction, "
+                        "flag mergeable scatter pairs (shared index "
+                        "vector, disjoint destinations) and enforce "
+                        "the per-target index budgets")
+    p.add_argument("--index-engine", default=None,
+                   choices=["async", "sync", "deep", "wave", "fused"],
+                   help="restrict the index audit to one engine "
+                        "(default: all five; async carries the "
+                        "sharded/RDMA parallel variants)")
+    p.add_argument("--index-nodes", type=int, default=None,
+                   metavar="N",
+                   help="node count for the index audit (default 8, "
+                        "the canonical budget-pinned size; budgets "
+                        "are only enforced at the default)")
     p.add_argument("--json", dest="json_path", default=None,
                    help="write the full JSON report here")
     p.add_argument("--lint-paths", nargs="*", default=None,
@@ -171,6 +194,14 @@ def _resolve_mutation(name):
             "support gates), which the protocol prongs never touch; "
             "run it through the kernel-contract prong (--kernel "
             "--skip-model-check --skip-lint)")
+    if name in mutations.INDEX_MUTATIONS:
+        raise SystemExit(
+            f"`{name}` is an index mutation — it re-splits the packed "
+            "commit scatters bit-identically, so every dynamic oracle "
+            "(model checker, fuzzer, conformance, goldens) stays "
+            "green; only the static index inventory can see it — run "
+            "it through the index prong (--index --skip-model-check "
+            "--skip-lint)")
     if name not in mutations.MUTATIONS:
         raise SystemExit(
             f"unknown mutation `{name}` (handler mutations: "
@@ -178,7 +209,8 @@ def _resolve_mutation(name):
             f"{', '.join(mutations.TABLE_MUTATIONS)}; consistency "
             f"mutations: {', '.join(mutations.CONSISTENCY_MUTATIONS)}; "
             f"kernel mutations: "
-            f"{', '.join(mutations.KERNEL_MUTATIONS)})")
+            f"{', '.join(mutations.KERNEL_MUTATIONS)}; index "
+            f"mutations: {', '.join(mutations.INDEX_MUTATIONS)})")
     return mutations.MUTATIONS[name]
 
 
@@ -296,6 +328,9 @@ def run_litmus(test_names, protocol_names, mutation, max_states,
 def run_lint(paths, quiet) -> dict:
     from ue22cs343bb1_openmp_assignment_tpu.analysis import lint_trace
     findings = lint_trace.lint_paths(paths)
+    # the no-jax boundary pass always runs over its own fixed targets
+    # (the daemon wire layer), independent of --lint-paths
+    findings.extend(lint_trace.lint_no_jax())
     n_files = len({f.file for f in findings})
     if findings:
         _print(quiet, f"== lint: FAIL ({len(findings)} findings in "
@@ -456,6 +491,59 @@ def run_kernel(nodes, static, mutation, quiet) -> dict:
     return rep
 
 
+def run_index(engine, nodes, mutation, max_states, quiet) -> dict:
+    """The index-pressure prong: static gather/scatter inventory,
+    plane attribution, indices/instr probes, merge detection and
+    budget enforcement (analysis/indexcheck.py). A seeded index
+    mutation skips the probe runs — the mutant is semantics-preserving
+    by construction, so only the static pass can kill it — and the run
+    must then FAIL with the documented budget breach AND name the
+    re-split planes as merge candidates (asserted here: a mutant the
+    auditor misses is itself a finding)."""
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import (indexcheck,
+                                                             mutations)
+    imut = mutations.INDEX_MUTATIONS.get(mutation) if mutation else None
+    if mutation is not None and imut is None and \
+            mutation not in mutations.MUTATIONS:
+        raise SystemExit(
+            f"unknown mutation `{mutation}` (index mutations: "
+            f"{', '.join(mutations.INDEX_MUTATIONS)})")
+
+    engines = None if engine is None else [engine]
+    nodes = indexcheck.DEFAULT_NODES if nodes is None else nodes
+    if imut is not None:
+        engines = engines or ["async"]   # the seam lives in step.cycle
+        _print(quiet, f"== seeded index mutation `{mutation}` "
+                      f"(expected finding: {imut[1]})")
+        with imut[0]():
+            rep = indexcheck.check(engines=engines, nodes=nodes,
+                                   probe=False)
+        kinds = [f["kind"] for f in rep["findings"]]
+        cands = [c for er in rep["engines"].values()
+                 for c in er["merge_candidates"]
+                 if c["scope"].startswith("step.cycle")]
+        rep["expected_kind"] = imut[1]
+        rep["mutant_killed"] = bool((not rep["ok"]) and imut[1] in kinds
+                                    and cands)
+        if not rep["mutant_killed"]:
+            # the auditor MISSED a seeded bug: that is the failure
+            rep["ok"] = False
+            rep["findings"].append({
+                "pass": "mutation", "kind": "mutant_survived",
+                "detail": f"seeded index mutation `{mutation}` was not "
+                          f"caught (expected `{imut[1]}` + merge "
+                          f"candidates in step.cycle, got "
+                          f"{kinds or 'no findings'} and "
+                          f"{len(cands)} candidates)"})
+    else:
+        rep = indexcheck.check(engines=engines, nodes=nodes,
+                               probe=True,
+                               probe_budget=min(max_states, 4096))
+    for line in indexcheck.render_text(rep):
+        _print(quiet, line)
+    return rep
+
+
 def run_fuzz(n_cases, seed, mutation, repro_dir, quiet,
              flight_dir=None) -> dict:
     from ue22cs343bb1_openmp_assignment_tpu.analysis import fuzz as fz
@@ -504,7 +592,7 @@ def main(argv=None) -> int:
 
     report = {"model_check": {}, "lint": None, "jaxpr": None,
               "fuzz": None, "table": None, "litmus": None,
-              "kernel": None}
+              "kernel": None, "index": None}
     ok, exhausted = True, False
     if not args.skip_model_check:
         report["model_check"] = run_model_check(
@@ -545,6 +633,13 @@ def main(argv=None) -> int:
                                       args.kernel_static, args.mutation,
                                       args.quiet)
         ok &= report["kernel"]["ok"]
+    if args.index:
+        report["index"] = run_index(args.index_engine, args.index_nodes,
+                                    args.mutation, args.max_states,
+                                    args.quiet)
+        if report["index"].get("budget_exhausted"):
+            exhausted = True
+        ok &= report["index"]["ok"]
     if args.fuzz > 0:
         report["fuzz"] = run_fuzz(args.fuzz, args.seed, args.mutation,
                                   args.repro_dir, args.quiet,

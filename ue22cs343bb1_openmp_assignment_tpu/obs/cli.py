@@ -940,6 +940,16 @@ def cmd_perfreport(args) -> int:
             kernelcheck)
         doc["vmem"] = kernelcheck.vmem_rows(
             cfg, device_kind=args.device_kind, trace=False)
+    # the static index-pressure row (analysis/indexcheck): per-plane
+    # gather/scatter attribution of the engine's hot body, with
+    # indices/instr derived from the same (steps, retired) integers
+    # that pin bytes/instr above — the machine-checked replacement for
+    # PERF.md's hand-counted index estimates
+    from ue22cs343bb1_openmp_assignment_tpu.analysis import indexcheck
+    if args.engine in indexcheck.ENGINES and retired:
+        doc["index"] = indexcheck.index_row(args.engine, args.nodes)
+        doc["index"]["indices_per_instr"] = round(
+            doc["index"]["indices_per_step"] * steps / retired, 3)
     if args.timing:
         timer = PhaseTimer()
         rep_times = []

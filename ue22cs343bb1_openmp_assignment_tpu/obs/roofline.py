@@ -456,6 +456,23 @@ def render_text(doc: dict) -> str:
                 f" = required {r['required_bytes'] / 2**20:.2f} MiB vs "
                 f"{r['vmem_bytes'] / 2**20:.0f} MiB VMEM "
                 f"({r['device_kind']}): {verdict}")
+    ix = doc.get("index")
+    if ix:
+        planes = ", ".join(f"{p}={v}"
+                           for p, v in sorted(ix["by_plane"].items()))
+        lines.append("")
+        lines.append(
+            f"  index pressure ({ix['target']}, static jaxpr audit): "
+            f"{ix['index_sites']} sites, "
+            f"{ix['indices_per_step']} indices/step"
+            + (f", {ix['indices_per_instr']} indices/instr"
+               if "indices_per_instr" in ix else ""))
+        lines.append(f"    by plane: {planes}")
+        if ix.get("merge_candidates"):
+            lines.append(
+                f"    ~ {ix['merge_candidates']} mergeable-scatter "
+                "candidate(s) — run `cache-sim analyze --index` for "
+                "the worklist")
     tr = doc.get("transport")
     if tr:
         per = tr["bytes_per_round"]

@@ -59,6 +59,17 @@ LEDGER_FIELDS = ("deq_has", "deq_sender", "deq_type", "deq_addr",
                  "fetch", "issue", "op", "addr", "value", "unblocked")
 LEDGER_OBS_FIELDS = ("obs_retire", "obs_val")
 
+#: commit-path seam for the index-pressure auditor's seeded mutation
+#: (analysis/mutations.INDEX_MUTATIONS.split_packed_scatter). True =
+#: the shipped packed row commit (one scatter per state family, the
+#: round-8 consolidation). False = the historical per-plane commit:
+#: bit-identical semantics (each split scatter writes its own old value
+#: where its column mask is unset, exactly like the packed where-select)
+#: but 3x the gather/scatter traffic — invisible to every dynamic
+#: oracle, caught only by the static index audit
+#: (analysis/indexcheck.py). Production code never flips this.
+_PACKED_COMMIT = True
+
 
 def cycle(cfg: SystemConfig, state: SimState,
           with_events: bool = False, message_phase=None,
@@ -139,17 +150,7 @@ def cycle(cfg: SystemConfig, state: SimState,
         merged(m_upd["cache_state"], f_upd["cache_state"]),
         merged(m_upd["cache_addr"], f_upd["cache_addr"]),
         merged(m_upd["cache_val"], f_upd["cache_val"]))
-    cache3 = jnp.stack([state.cache_state, state.cache_addr,
-                        state.cache_val], axis=-1)        # [N, C, 3]
-    old_c = cache3[rows, jnp.clip(cidx, 0, C - 1)]        # [N, 3]
-    row_c = jnp.stack([jnp.where(m, v, old_c[:, k])
-                       for k, (m, v) in enumerate(zip(cmasks, cvals))],
-                      axis=-1)
     any_c = cmasks[0] | cmasks[1] | cmasks[2]
-    cache3 = cache3.at[rows, jnp.where(any_c, cidx, C)].set(
-        row_c, mode="drop")
-    cache_state, cache_addr, cache_val = (
-        cache3[..., 0], cache3[..., 1], cache3[..., 2])
 
     M = cfg.mem_size
     mm, mi, mval = m_upd["mem"]
@@ -158,24 +159,69 @@ def cycle(cfg: SystemConfig, state: SimState,
     # the handlers emit one block index for all three (p_block); the
     # nested where keeps the first set mask's index authoritative
     hidx = jnp.where(mm, mi, jnp.where(dm, di, bi))
-    bv_i32 = jax.lax.bitcast_convert_type(state.dir_bitvec, jnp.int32)
-    home = jnp.concatenate(
-        [state.memory[..., None], state.dir_state[..., None], bv_i32],
-        axis=-1)                                          # [N, M, 2+Wb]
-    old_h = home[rows, jnp.clip(hidx, 0, M - 1)]          # [N, 2+Wb]
-    row_h = jnp.concatenate(
-        [jnp.where(mm, mval, old_h[:, 0])[:, None],
-         jnp.where(dm, dval, old_h[:, 1])[:, None],
-         jnp.where(bm[:, None],
-                   jax.lax.bitcast_convert_type(bval, jnp.int32),
-                   old_h[:, 2:])],
-        axis=-1)
     any_h = mm | dm | bm
-    home = home.at[rows, jnp.where(any_h, hidx, M)].set(
-        row_h, mode="drop")
-    memory, dir_state = home[..., 0], home[..., 1]
-    dir_bitvec = jax.lax.bitcast_convert_type(home[..., 2:],
-                                              jnp.uint32)
+
+    if _PACKED_COMMIT:
+        cache3 = jnp.stack([state.cache_state, state.cache_addr,
+                            state.cache_val], axis=-1)    # [N, C, 3]
+        old_c = cache3[rows, jnp.clip(cidx, 0, C - 1)]    # [N, 3]
+        row_c = jnp.stack([jnp.where(m, v, old_c[:, k])
+                           for k, (m, v) in
+                           enumerate(zip(cmasks, cvals))],
+                          axis=-1)
+        cache3 = cache3.at[rows, jnp.where(any_c, cidx, C)].set(
+            row_c, mode="drop")
+        cache_state, cache_addr, cache_val = (
+            cache3[..., 0], cache3[..., 1], cache3[..., 2])
+
+        bv_i32 = jax.lax.bitcast_convert_type(state.dir_bitvec,
+                                              jnp.int32)
+        home = jnp.concatenate(
+            [state.memory[..., None], state.dir_state[..., None],
+             bv_i32],
+            axis=-1)                                      # [N, M, 2+Wb]
+        old_h = home[rows, jnp.clip(hidx, 0, M - 1)]      # [N, 2+Wb]
+        row_h = jnp.concatenate(
+            [jnp.where(mm, mval, old_h[:, 0])[:, None],
+             jnp.where(dm, dval, old_h[:, 1])[:, None],
+             jnp.where(bm[:, None],
+                       jax.lax.bitcast_convert_type(bval, jnp.int32),
+                       old_h[:, 2:])],
+            axis=-1)
+        home = home.at[rows, jnp.where(any_h, hidx, M)].set(
+            row_h, mode="drop")
+        memory, dir_state = home[..., 0], home[..., 1]
+        dir_bitvec = jax.lax.bitcast_convert_type(home[..., 2:],
+                                                  jnp.uint32)
+    else:
+        # de-consolidated commit — the _PACKED_COMMIT seam's mutant
+        # path (never shipped): one scatter per plane, every split
+        # scatter in a family sharing the same literal index vector,
+        # each unset column writing back its own gathered old value.
+        # Bit-identical to the packed path above; only the static
+        # index inventory can tell them apart.
+        idx_c = jnp.where(any_c, cidx, C)
+        clip_c = jnp.clip(cidx, 0, C - 1)
+        idx_h = jnp.where(any_h, hidx, M)
+        clip_h = jnp.clip(hidx, 0, M - 1)
+
+        def plane_commit(plane, mask, val, idx, clip):
+            old = plane[rows, clip]
+            return plane.at[rows, idx].set(
+                jnp.where(mask, val, old), mode="drop")
+
+        cache_state = plane_commit(state.cache_state, cmasks[0],
+                                   cvals[0], idx_c, clip_c)
+        cache_addr = plane_commit(state.cache_addr, cmasks[1],
+                                  cvals[1], idx_c, clip_c)
+        cache_val = plane_commit(state.cache_val, cmasks[2],
+                                 cvals[2], idx_c, clip_c)
+        memory = plane_commit(state.memory, mm, mval, idx_h, clip_h)
+        dir_state = plane_commit(state.dir_state, dm, dval, idx_h,
+                                 clip_h)
+        old_bv = state.dir_bitvec[rows, clip_h]
+        dir_bitvec = state.dir_bitvec.at[rows, idx_h].set(
+            jnp.where(bm[:, None], bval, old_bv), mode="drop")
 
     waiting = (state.waiting & ~m_upd["wait_clear"]) | f_upd["wait_set"]
     # stall-watchdog input: cycle the current wait began (-1 when idle)
