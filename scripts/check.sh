@@ -54,6 +54,12 @@
 # recorded: head vs itself must pass (exit 0) and a synthetic +20%
 # bytes vector must be a regression (exit 4). Both boxed ≤30 s.
 #
+# The ops smoke (≤30 s per step) drives the live ops plane over a
+# real socket: a daemon with --events-dir and a forced-breach
+# --burn-slo, a `cache-sim watch` stream, a `cache-sim top --once`
+# fleet snapshot (JSON + Prometheus), and an on-disk event stream
+# that must validate and carry the slo-alert.
+#
 # The rdma smoke (≤30 s, 8 virtual CPU devices) checks the Pallas
 # remote-DMA lane router in interpret mode against the all_to_all
 # router bit-for-bit and gates rdma's bytes-on-wire strictly below
@@ -410,6 +416,71 @@ print(f"record/replay smoke: ok ({doc['jobs_total']} jobs captured "
       f"recorded-vs-replayed latency verdict pass)")
 PY
 rm -rf "$REC_DIR"
+
+# Ops-plane smoke (each step 30s-boxed): the live observability plane
+# end to end over a real socket. Start a daemon with an --events-dir
+# and a deliberately unmeetable burn-rate SLO (sub-ns threshold: every
+# job is "bad", both windows light up on the first samples), submit
+# jobs, follow the stream with `cache-sim watch` (must capture the
+# admitted/quiesced events and at least one stats delta), aggregate
+# the replica with `cache-sim top --once` (exact-sum fleet doc +
+# Prometheus exposition), then shut down and check the on-disk event
+# stream validates and carries the forced slo-alert.
+OPS_DIR="$(mktemp -d)"
+OSOCK="$OPS_DIR/daemon.sock"
+python -m ue22cs343bb1_openmp_assignment_tpu.cli daemon \
+    --addr "$OSOCK" --slots 2 --chunk 8 --quiet \
+    --events-dir "$OPS_DIR/events" \
+    --burn-slo "0.000001ms,fast=60,slow=300,factor=2" &
+OPID=$!
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.cli \
+    submit --addr "$OSOCK" --wait-up 25 --wait --timeout 25 \
+    --job '{"name":"ops0","workload":"uniform","nodes":2,"trace_len":4,"lane":"interactive"}' \
+    --job '{"name":"ops1","workload":"hotspot","nodes":2,"trace_len":4,"lane":"batch"}'
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.cli \
+    watch --addr "$OSOCK" --interval 0.05 --max-s 10 --max-rows 50 \
+    --json > "$OPS_DIR/watch.ndjson"
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.cli \
+    top "$OSOCK" --once --json > "$OPS_DIR/fleet.json"
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.cli \
+    top "$OSOCK" --once --prom > "$OPS_DIR/fleet.prom"
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.cli \
+    submit --addr "$OSOCK" --drain --shutdown > /dev/null
+for _ in $(seq 1 60); do
+    kill -0 "$OPID" 2>/dev/null || break
+    sleep 0.5
+done
+if kill -0 "$OPID" 2>/dev/null; then
+    echo "ops smoke FAILED: daemon still running after shutdown" >&2
+    kill -9 "$OPID"
+    exit 1
+fi
+wait "$OPID" || true
+python - "$OPS_DIR" <<'PY'
+import json, pathlib, sys
+from ue22cs343bb1_openmp_assignment_tpu.obs import events, schema
+d = pathlib.Path(sys.argv[1])
+rows = [json.loads(ln) for ln
+        in (d / "watch.ndjson").read_text().splitlines()]
+types = [r.get("type") for r in rows]
+assert types[0] == "stats" and rows[-1]["type"] == "end", types
+assert types.count("stats") >= 1, types
+art = events.load(d / "events")          # validates on load
+kinds = {r["kind"] for r in art["rows"]}
+assert {"submit-accepted", "admitted", "quiesced"} <= kinds, kinds
+assert "slo-alert" in kinds, \
+    f"forced burn-rate breach missing from event stream: {kinds}"
+fleet = json.loads((d / "fleet.json").read_text())
+schema.validate_fleet(fleet)
+assert fleet["replicas"] == 1 and fleet["jobs"]["done"] >= 2, fleet
+assert fleet["slo_alerts"] >= 1, fleet
+prom = (d / "fleet.prom").read_text()
+assert "cache_sim_jobs_done_total" in prom
+print(f"ops smoke: ok ({len(rows)} watch rows, "
+      f"{len(art['rows'])} events incl. forced slo-alert, fleet doc "
+      f"validated, {fleet['jobs']['done']} jobs done)")
+PY
+rm -rf "$OPS_DIR"
 
 # RDMA-transport smoke (30s box): on 8 virtual CPU devices the Pallas
 # remote-DMA ring router (interpret mode — the CPU CI correctness

@@ -1,0 +1,445 @@
+"""The live ops plane: structured event stream, watch streaming,
+Prometheus exposition, burn-rate alerting, and the fleet aggregator.
+
+Anchors pinned here:
+
+- **Event byte-determinism**: two identical VirtualClock daemon
+  sessions with an attached emitter produce byte-identical
+  ``cache-sim/events/v1`` streams (``dumps()`` equality), and the
+  stream passes its own validator (strictly increasing seq,
+  non-decreasing t_s).
+- **Ring bounding**: the emitter holds at most ``ring`` rows; dropped
+  rows are counted, never silently lost from the accounting.
+- **Watch over a live socket**: the long-lived ``watch`` verb pushes
+  a baseline stats row, then event rows and stats deltas, then a
+  terminal end row — and the connection is reusable for plain
+  request/response afterwards. A bare ``watch`` through the
+  request/response path errors instead of falling through.
+- **Fleet merge exactness**: ``cache-sim/fleet/v1`` counters equal
+  the integer sums of the per-replica docs; shared-edge histograms
+  merge elementwise; mismatched edges are refused.
+- **Burn-rate matrix**: an alert needs BOTH windows burning; the
+  hysteresis latch yields one alert per excursion and recovery
+  re-arms it.
+- **Exposition golden**: the Prometheus text rendering of a fixed
+  stats doc is byte-pinned.
+- **Empty-sample hardening**: ``percentile`` of an empty sample is a
+  clear ValueError (list and numpy array alike), and
+  ``latency_summary`` of nothing is None, not a crash.
+"""
+
+import json
+import pathlib
+import threading
+
+import pytest
+
+from ue22cs343bb1_openmp_assignment_tpu.daemon.client import DaemonClient
+from ue22cs343bb1_openmp_assignment_tpu.daemon.core import (
+    DaemonCore, attach_emitter, drive)
+from ue22cs343bb1_openmp_assignment_tpu.daemon.server import DaemonServer
+from ue22cs343bb1_openmp_assignment_tpu.obs import burnrate, events, fleet
+from ue22cs343bb1_openmp_assignment_tpu.obs import schema as obs_schema
+from ue22cs343bb1_openmp_assignment_tpu.obs import promexpo
+from ue22cs343bb1_openmp_assignment_tpu.obs.clock import VirtualClock
+from ue22cs343bb1_openmp_assignment_tpu.serve import JobSpec
+
+GOLDEN = pathlib.Path(__file__).parent / "golden"
+
+
+def _spec(name, nodes=2, trace_len=4, workload="uniform", seed=0):
+    return JobSpec(name=name, workload=workload, nodes=nodes,
+                   trace_len=trace_len, seed=seed)
+
+
+def _driven_core(schedule=None, **kw):
+    core = DaemonCore(slots=2, max_buckets=2, chunk=8,
+                      clock=VirtualClock(), **kw)
+    em = attach_emitter(core)
+    drive(core, schedule if schedule is not None else [
+        (0.0, _spec("a"), "batch"),
+        (0.001, _spec("b"), "interactive"),
+        (0.002, _spec("c", nodes=4), "batch"),
+    ])
+    return core, em
+
+
+# -- event stream ----------------------------------------------------------
+
+
+def test_event_stream_byte_deterministic():
+    _, e1 = _driven_core()
+    _, e2 = _driven_core()
+    assert e1.dumps() == e2.dumps()
+    assert e1.rows, "a driven session must emit events"
+    events.validate(None, e1.rows, "run")
+    kinds = [r["kind"] for r in e1.rows]
+    assert "submit-accepted" in kinds
+    assert "admitted" in kinds
+    assert "quiesced" in kinds
+    # admitted rows carry the wave/slot placement
+    adm = next(r for r in e1.rows if r["kind"] == "admitted")
+    assert "wave" in adm and "slot" in adm and "bucket" in adm
+    qui = next(r for r in e1.rows if r["kind"] == "quiesced")
+    assert qui["ok"] and qui["e2e_ms"] > 0
+
+
+def test_event_stream_rides_the_stats_doc():
+    core, em = _driven_core()
+    stats = core.stats()
+    obs_schema.validate_daemon_stats(stats)
+    assert stats["events"] == {"path": None, "ring": events.DEFAULT_RING,
+                               "seq": em.seq, "dropped": 0}
+    # stats_seq is monotonic per snapshot
+    assert core.stats()["stats_seq"] == stats["stats_seq"] + 1
+    # per-lane latency histograms ride along and agree with the jobs
+    hist = stats["lanes"]["batch"]["hist"]
+    assert hist is not None
+    assert sum(hist["counts"]) == hist["count"] == 2
+
+
+def test_event_ring_bounds_memory_not_accounting():
+    core = DaemonCore(slots=2, max_buckets=1, chunk=8,
+                      clock=VirtualClock())
+    em = attach_emitter(core, ring=4)
+    drive(core, [(0.001 * i, _spec(f"j{i}"), "batch")
+                 for i in range(6)])
+    assert len(em.rows) <= 4
+    assert em.dropped == em.seq - len(em.rows) > 0
+    # the surviving window still validates on its own
+    events.validate(None, em.rows, "ring")
+
+
+def test_event_file_round_trip(tmp_path):
+    core = DaemonCore(slots=2, max_buckets=2, chunk=8,
+                      clock=VirtualClock())
+    em = attach_emitter(core, path=tmp_path)
+    drive(core, [(0.0, _spec("a"), "batch")])
+    em.close()
+    art = events.load(tmp_path / events.FILENAME)
+    assert art["schema"] == events.SCHEMA_ID
+    assert art["clock"] == "virtual"
+    assert [r["kind"] for r in art["rows"]] == \
+        [r["kind"] for r in em.rows]
+
+
+def test_event_validator_rejects_malformed():
+    ok = {"seq": 0, "t_s": 0.0, "kind": "admitted", "job": "a"}
+    events.validate(None, [ok], "v")
+    with pytest.raises(ValueError, match="kind"):
+        events.validate(None, [dict(ok, kind="warp-drive")], "v")
+    with pytest.raises(ValueError, match="seq"):
+        events.validate(None, [dict(ok), dict(ok, seq=0)], "v")
+    with pytest.raises(ValueError, match="t_s"):
+        events.validate(
+            None, [dict(ok, t_s=1.0), dict(ok, seq=1, t_s=0.5)], "v")
+
+
+def test_lane_reject_and_eviction_events():
+    core = DaemonCore(slots=2, max_buckets=1, chunk=8,
+                      clock=VirtualClock(), lane_depth=2,
+                      retain_results=2)
+    em = attach_emitter(core)
+    # a burst overflows the 2-deep batch queue; the stragglers land
+    # after the burst drains and push completions past retain_results
+    sched = [(0.0, _spec(f"q{i}"), "batch") for i in range(5)]
+    sched += [(0.5, _spec("late0"), "batch"),
+              (0.6, _spec("late1"), "batch")]
+    drive(core, sched)
+    kinds = [r["kind"] for r in em.rows]
+    assert "lane-reject" in kinds
+    rej = next(r for r in em.rows if r["kind"] == "lane-reject")
+    assert rej["reason"] == "queue-full"
+    assert "result-evicted" in kinds
+
+
+# -- watch streaming -------------------------------------------------------
+
+
+def _serving(tmp_path, **core_kw):
+    addr = str(tmp_path / "sock")
+    core = DaemonCore(slots=2, max_buckets=2, chunk=8, **core_kw)
+    srv = DaemonServer(core, addr)
+    t = threading.Thread(target=srv.run, daemon=True)
+    t.start()
+    return srv, addr, t
+
+
+def test_watch_streams_stats_and_events(tmp_path):
+    srv, addr, t = _serving(tmp_path)
+    try:
+        with DaemonClient(addr, timeout_s=None) as c:
+            c.wait_up(10)
+            for i in range(3):
+                r = c.submit(_spec(f"j{i}"))
+                assert r.get("status") == "queued", r
+            rows = list(c.watch(interval_s=0.05, max_s=10.0,
+                                max_rows=80))
+            types = [r.get("type") for r in rows]
+            assert types[0] == "stats", "baseline stats row first"
+            assert rows[-1]["type"] == "end"
+            assert rows[-1]["reason"] in ("max-rows", "max-s")
+            kinds = [r["event"]["kind"] for r in rows
+                     if r.get("type") == "event"]
+            assert "quiesced" in kinds
+            evs = [r["event"] for r in rows
+                   if r.get("type") == "event"]
+            events.validate(None, evs, "watch")
+            # stream over: the connection answers plain requests again
+            assert c.ping().get("ok")
+            assert c.stats()["jobs"]["done"] == 3
+    finally:
+        srv.stop()
+        t.join(timeout=10)
+
+
+def test_watch_max_rows_bounds_the_stream(tmp_path):
+    srv, addr, t = _serving(tmp_path)
+    try:
+        with DaemonClient(addr, timeout_s=None) as c:
+            c.wait_up(10)
+            rows = list(c.watch(interval_s=0.02, max_rows=1))
+            assert [r["type"] for r in rows] == ["stats", "end"]
+            assert rows[-1]["reason"] == "max-rows"
+    finally:
+        srv.stop()
+        t.join(timeout=10)
+
+
+def test_watch_through_request_path_errors_not_shuts_down(tmp_path):
+    srv, addr, t = _serving(tmp_path)
+    try:
+        resp = srv._handle({"op": "watch"})
+        assert resp.get("error")
+        assert not srv._stop.is_set(), \
+            "a stray watch request must not shut the daemon down"
+    finally:
+        srv.stop()
+        t.join(timeout=10)
+
+
+# -- fleet aggregation -----------------------------------------------------
+
+
+def test_fleet_merge_counters_are_exact_sums():
+    c1, _ = _driven_core()
+    c2, _ = _driven_core([(0.0, _spec("x"), "batch"),
+                          (0.001, _spec("y"), "batch")])
+    s1, s2 = c1.stats(), c2.stats()
+    doc = fleet.merge_stats([s1, s2], labels=["A", "B"])
+    obs_schema.validate_fleet(doc)
+    for k in ("submitted", "rejected", "done", "quiesced"):
+        assert doc["jobs"][k] == s1["jobs"][k] + s2["jobs"][k]
+    assert doc["chunks"] == s1["chunks"] + s2["chunks"]
+    assert doc["uptime_s"] == max(s1["uptime_s"], s2["uptime_s"])
+    assert doc["replicas"] == 2
+    assert [r["replica"] for r in doc["per_replica"]] == ["A", "B"]
+    # histogram merge is elementwise-exact
+    h1 = s1["lanes"]["batch"]["hist"]
+    h2 = s2["lanes"]["batch"]["hist"]
+    hm = doc["lanes"]["batch"]["hist"]
+    assert hm["count"] == h1["count"] + h2["count"]
+    assert hm["counts"] == [a + b for a, b
+                            in zip(h1["counts"], h2["counts"])]
+    assert hm["sum_ms"] == pytest.approx(h1["sum_ms"] + h2["sum_ms"])
+    # buckets keep replica identity instead of being summed
+    assert all("replica" in b for b in doc["buckets"])
+    # the fleet doc renders through both human surfaces
+    assert "TOTAL" in fleet.render_top(doc)
+    assert "cache_sim_jobs_done_total" in promexpo.render(doc)
+
+
+def test_fleet_merge_refuses_bad_input():
+    with pytest.raises(ValueError):
+        fleet.merge_stats([])
+    c1, _ = _driven_core()
+    with pytest.raises(ValueError):
+        fleet.merge_stats([c1.stats()], labels=["a", "b"])
+
+
+def test_fleet_hist_merge_refuses_mismatched_edges():
+    a = {"edges_ms": [1.0, 2.0], "counts": [1, 0, 0], "count": 1,
+         "sum_ms": 0.5}
+    b = {"edges_ms": [1.0, 4.0], "counts": [0, 1, 0], "count": 1,
+         "sum_ms": 3.0}
+    with pytest.raises(ValueError, match="mismatched bucket edges"):
+        fleet._merge_hists([a, b])
+    merged = fleet._merge_hists([a, dict(a)])
+    assert merged["counts"] == [2, 0, 0] and merged["count"] == 2
+    assert fleet._merge_hists([None, None]) is None
+
+
+def test_fleet_tolerates_pre_ops_stats_docs():
+    """A v1 stats doc from before this PR (no stats_seq / hist /
+    events / slo_alerts) still validates and still merges."""
+    core, _ = _driven_core()
+    old = json.loads(json.dumps(core.stats()))
+    for k in ("stats_seq", "events", "slo_alerts", "burnrate"):
+        old.pop(k, None)
+    for lane in old["lanes"].values():
+        lane.pop("hist", None)
+    obs_schema.validate_daemon_stats(old)
+    doc = fleet.merge_stats([old], labels=["legacy"])
+    assert doc["slo_alerts"] == 0
+    assert doc["per_replica"][0]["stats_seq"] is None
+
+
+# -- burn-rate alerting ----------------------------------------------------
+
+
+def _feed(mon, t0, t1, latency_s, n=50):
+    dt = (t1 - t0) / n
+    out = []
+    for i in range(n):
+        a = mon.feed(t0 + i * dt, latency_s)
+        if a:
+            out.append(a)
+    return out
+
+
+def test_burn_needs_both_windows():
+    # a short bad burst lights the fast window but not the slow one
+    mon = burnrate.BurnRateMonitor(threshold_ms=5.0, objective=0.99,
+                                   fast_s=10.0, slow_s=100.0,
+                                   factor=2.0)
+    _feed(mon, 0.0, 90.0, 0.001, n=1000)  # dense good traffic
+    _feed(mon, 90.0, 95.0, 0.5, n=10)     # 5s burst of 500ms jobs
+    s = mon.summary()
+    assert s["fast_burn"] >= 2.0
+    assert s["slow_burn"] < 2.0
+    assert not mon.breached(), \
+        "fast-only burn must not page (transient spike)"
+
+
+def test_burn_alert_is_edge_triggered_and_rearms():
+    mon = burnrate.BurnRateMonitor(threshold_ms=5.0, objective=0.99,
+                                   fast_s=10.0, slow_s=30.0,
+                                   factor=2.0)
+    first = _feed(mon, 0.0, 40.0, 0.5)    # sustained breach
+    assert len(first) == 1, "hysteresis: one alert per excursion"
+    assert mon.breached() and mon.summary()["alerting"]
+    # recovery: both windows drain below the factor
+    _feed(mon, 40.0, 120.0, 0.001)
+    assert not mon.summary()["alerting"]
+    again = _feed(mon, 120.0, 160.0, 0.5)
+    assert len(again) == 1, "a fresh excursion re-alerts"
+    assert len(mon.alerts) == 2
+    a = mon.alerts[0]
+    assert a["fast_burn"] >= 2.0 and a["slow_burn"] >= 2.0
+    assert a["threshold_ms"] == 5.0
+
+
+def test_parse_burn_spec():
+    m = burnrate.parse_burn_spec(
+        "5ms,objective=0.999,fast=30,slow=120,factor=4")
+    assert m == {"threshold_ms": 5.0, "objective": 0.999,
+                 "fast_s": 30.0, "slow_s": 120.0, "factor": 4.0}
+    assert burnrate.parse_burn_spec("2.5") == {"threshold_ms": 2.5}
+    with pytest.raises(ValueError):
+        burnrate.parse_burn_spec("")
+    with pytest.raises(ValueError):
+        burnrate.parse_burn_spec("5ms,warp=9")
+
+
+def test_burn_feeds_from_daemon_core():
+    mon = burnrate.monitor_from_spec("0.000001ms,fast=60,slow=300")
+    core = DaemonCore(slots=2, max_buckets=2, chunk=8,
+                      clock=VirtualClock(), burn=mon)
+    attach_emitter(core)
+    drive(core, [(0.0, _spec("a"), "batch"),
+                 (0.001, _spec("b"), "batch")])
+    stats = core.stats()
+    assert stats["slo_alerts"] >= 1
+    assert stats["burnrate"]["samples"] == 2
+    assert any(r["kind"] == "slo-alert" for r in core.emitter.rows)
+    obs_schema.validate_daemon_stats(stats)
+
+
+# -- exposition golden -----------------------------------------------------
+
+_FIXED_STATS = {
+    "schema": "cache-sim/daemon-stats/v1",
+    "clock": "virtual",
+    "uptime_s": 12.5,
+    "stats_seq": 7,
+    "jobs": {"submitted": 10, "rejected": 2, "done": 8, "quiesced": 8},
+    "lanes": {
+        "batch": {"queued": 1, "submitted": 7, "admitted": 6,
+                  "rejected": 2, "done": 5,
+                  "hist": {"edges_ms": [1.0, 2.0, 4.0],
+                           "counts": [1, 2, 1, 1], "count": 5,
+                           "sum_ms": 11.5}},
+        "interactive": {"queued": 0, "submitted": 3, "admitted": 3,
+                        "rejected": 0, "done": 3, "hist": None},
+    },
+    "buckets": [{"bucket": "mesi:2x4", "busy": 1, "admitted": 6,
+                 "chunks": 3}],
+    "chunks": 4,
+    "busy_s": 9.25,
+    "mb_dropped": 0,
+    "mid_wave_swaps": 1,
+    "bucket_growths": 0,
+    "results_evicted": 2,
+    "slo_alerts": 1,
+    "queue_depth_peak": 3,
+    "draining": False,
+}
+
+
+def test_promexpo_golden():
+    text = promexpo.render(_FIXED_STATS)
+    golden = GOLDEN / "promexpo.txt"
+    assert text == golden.read_text(), \
+        f"regenerate with: python -c \"import json,sys; " \
+        f"sys.path.insert(0,'tests'); from test_ops_plane import " \
+        f"_FIXED_STATS; from " \
+        f"ue22cs343bb1_openmp_assignment_tpu.obs import promexpo; " \
+        f"open('{golden}','w').write(" \
+        f"promexpo.render(_FIXED_STATS))\""
+
+
+def test_promexpo_histogram_is_cumulative():
+    text = promexpo.render(_FIXED_STATS)
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("cache_sim_job_latency_ms")]
+    by_le = [ln for ln in lines if "le=" in ln]
+    counts = [float(ln.rsplit(" ", 1)[1]) for ln in by_le]
+    assert counts == sorted(counts), "le buckets must be cumulative"
+    assert by_le[-1].startswith(
+        'cache_sim_job_latency_ms_bucket{lane="batch",le="+Inf"}')
+    assert counts[-1] == 5.0
+
+
+def test_promexpo_skips_missing_keys():
+    text = promexpo.render({"jobs": {"done": 3}})
+    assert "cache_sim_jobs_done_total 3" in text
+    assert "uptime" not in text
+
+
+# -- empty-sample hardening ------------------------------------------------
+
+
+def test_percentile_of_empty_sample_raises():
+    from ue22cs343bb1_openmp_assignment_tpu.obs import timeseries
+    import numpy as np
+    with pytest.raises(ValueError, match="empty sample"):
+        timeseries.percentile([], 95.0)
+    with pytest.raises(ValueError, match="empty sample"):
+        timeseries.percentile(np.array([]), 95.0)
+    assert timeseries.latency_summary([]) is None
+    assert timeseries.latency_summary(np.array([])) is None
+
+
+def test_log_histogram_observe_and_merge():
+    from ue22cs343bb1_openmp_assignment_tpu.obs import timeseries
+    h = timeseries.LogHistogram()
+    for ms in (0.0005, 1.0, 3.0, 1e9):
+        h.observe(ms)
+    doc = h.to_doc()
+    assert doc["count"] == 4 == sum(doc["counts"])
+    assert doc["counts"][-1] == 1, "1e9 ms lands in the overflow"
+    assert doc["edges_ms"] == list(timeseries.HIST_EDGES_MS)
+    merged = timeseries.merge_hist_docs([doc, doc])
+    assert merged["count"] == 8
+    assert merged == fleet._merge_hists([doc, doc]), \
+        "the inline jax-free twin must agree with timeseries"

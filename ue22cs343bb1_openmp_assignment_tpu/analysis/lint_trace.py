@@ -47,9 +47,10 @@ point.
 
 A separate boundary pass, ``no-jax``, guards the opposite contract:
 the daemon's wire layer (``daemon/server.py``, ``daemon/client.py``)
-must stay importable on machines with no accelerator stack — socket +
-json only, jax reaches the process solely through the worker the
-server spawns. Any ``import jax``/``jaxlib``, any ``jax``/``jnp`` name
+and the live ops plane (``obs/events.py``, ``obs/promexpo.py``,
+``obs/burnrate.py``, ``obs/fleet.py``) must stay importable on
+machines with no accelerator stack — socket + json only, jax reaches
+the process solely through the worker the server spawns. Any ``import jax``/``jaxlib``, any ``jax``/``jnp`` name
 reference, or an ``importlib.import_module("jax...")`` in those files
 is a finding.
 
@@ -560,11 +561,15 @@ _JAX_ROOTS = {"jax", "jaxlib", "jnp"}
 
 def no_jax_targets() -> List[pathlib.Path]:
     """The files that must stay jax-free: the daemon's wire layer
-    (PR 15).  A client submitting a job, or the server's admission
-    loop, must never pay jax import time or pull in the accelerator
-    stack — device work lives behind the spawned worker boundary."""
+    (PR 15) plus the live ops plane (PR 19).  A client submitting a
+    job, the server's admission loop, a watch stream, a Prometheus
+    scrape, or a fleet poll must never pay jax import time or pull in
+    the accelerator stack — device work lives behind the spawned
+    worker boundary."""
     pkg = pathlib.Path(__file__).resolve().parents[1]
-    return [pkg / "daemon" / "server.py", pkg / "daemon" / "client.py"]
+    return [pkg / "daemon" / "server.py", pkg / "daemon" / "client.py",
+            pkg / "obs" / "events.py", pkg / "obs" / "promexpo.py",
+            pkg / "obs" / "burnrate.py", pkg / "obs" / "fleet.py"]
 
 
 def lint_no_jax_source(src: str,

@@ -642,6 +642,14 @@ def main(argv=None) -> int:
         from ue22cs343bb1_openmp_assignment_tpu.daemon import (
             client as daemon_client)
         return daemon_client.main(raw[1:])
+    if raw[:1] == ["watch"]:
+        from ue22cs343bb1_openmp_assignment_tpu.daemon import (
+            client as daemon_client)
+        return daemon_client.main_watch(raw[1:])
+    if raw[:1] == ["top"]:
+        from ue22cs343bb1_openmp_assignment_tpu.obs import (
+            fleet as fleet_mod)
+        return fleet_mod.main(raw[1:])
     if raw[:1] == ["replay"]:
         from ue22cs343bb1_openmp_assignment_tpu import (
             replay as replay_mod)

@@ -129,6 +129,38 @@ class DaemonClient:
         return self.request(op="stats")["stats"]
 
     # lint: host
+    def watch(self, interval_s: Optional[float] = None,
+              max_rows: Optional[int] = None,
+              max_s: Optional[float] = None):
+        """Generator over the ``watch`` stream: yields the pushed
+        rows (``type`` ``"stats"`` / ``"event"``) and returns after
+        the terminal ``"end"`` row (also yielded), leaving the
+        connection usable for plain requests again."""
+        if self._sock is None:
+            self.connect()
+        req = {"op": "watch"}
+        if interval_s is not None:
+            req["interval_s"] = float(interval_s)
+        if max_rows is not None:
+            req["max_rows"] = int(max_rows)
+        if max_s is not None:
+            req["max_s"] = float(max_s)
+        self._file.write(protocol.encode(req))
+        self._file.flush()
+        ack = protocol.decode(self._file.readline() or b"null")
+        if not ack.get("ok") or not ack.get("streaming"):
+            raise ConnectionError(f"watch not acked: {ack}")
+        while True:
+            line = self._file.readline()
+            if not line:
+                raise ConnectionError(
+                    f"daemon at {self.addr} closed the watch stream")
+            row = protocol.decode(line)
+            yield row
+            if row.get("type") == "end":
+                return
+
+    # lint: host
     def trace(self) -> dict:
         return self.request(op="trace")["trace"]
 
@@ -245,6 +277,74 @@ def main(argv=None) -> int:
             if not args.json:
                 print("daemon stopping", file=sys.stderr)
     return rc
+
+
+# lint: host
+def main_watch(argv=None) -> int:
+    """``cache-sim watch`` entry point: follow one daemon's live ops
+    stream (stats deltas + structured events) over its socket."""
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="cache-sim watch",
+        description="stream a running daemon's live ops plane: "
+                    "stats-deltas plus every scheduler event "
+                    "(cache-sim/events/v1) as they happen")
+    ap.add_argument("--addr", required=True,
+                    help="daemon address: unix socket path or "
+                         "tcp:HOST:PORT")
+    ap.add_argument("--interval", type=float, default=None,
+                    metavar="S",
+                    help="server poll cadence in seconds (default "
+                         f"{protocol.DEFAULT_WATCH_INTERVAL_S})")
+    ap.add_argument("--max-rows", type=int, default=None, metavar="N",
+                    help="stop after N pushed rows")
+    ap.add_argument("--max-s", type=float, default=None, metavar="S",
+                    help="stop after S seconds")
+    ap.add_argument("--wait-up", type=float, default=None, metavar="S",
+                    help="retry-connect for up to S seconds first")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw NDJSON rows instead of the "
+                         "human one-liners")
+    args = ap.parse_args(argv)
+
+    alerts = 0
+    # blocking socket: an idle daemon pushes nothing between deltas,
+    # so a read timeout would tear the stream down mid-watch
+    with DaemonClient(args.addr, timeout_s=None) as client:
+        if args.wait_up is not None:
+            client.wait_up(args.wait_up)
+        for row in client.watch(interval_s=args.interval,
+                                max_rows=args.max_rows,
+                                max_s=args.max_s):
+            if args.json:
+                print(json.dumps(row, sort_keys=True), flush=True)
+                continue
+            kind = row.get("type")
+            if kind == "stats":
+                s = row["stats"]
+                jobs = s["jobs"]
+                print(f"[stats #{s.get('stats_seq', '?')}] "
+                      f"up={s['uptime_s']:.3f}s "
+                      f"submitted={jobs['submitted']} "
+                      f"done={jobs['done']} "
+                      f"rejected={jobs['rejected']} "
+                      f"chunks={s['chunks']} "
+                      f"alerts={s.get('slo_alerts', 0)}", flush=True)
+            elif kind == "event":
+                ev = dict(row["event"])
+                seq = ev.pop("seq")
+                t_s = ev.pop("t_s")
+                k = ev.pop("kind")
+                job = ev.pop("job", None)
+                alerts += int(k == "slo-alert")
+                extra = " ".join(f"{n}={v}" for n, v
+                                 in sorted(ev.items()))
+                print(f"[{t_s:9.3f}s #{seq}] {k:<15} "
+                      f"{job or '-':<16} {extra}", flush=True)
+            else:
+                print(f"[end] {row.get('reason')} "
+                      f"({row.get('rows')} rows)", flush=True)
+    return 0
 
 
 if __name__ == "__main__":

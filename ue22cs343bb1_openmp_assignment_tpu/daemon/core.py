@@ -64,7 +64,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ue22cs343bb1_openmp_assignment_tpu.daemon import bucketing, protocol
-from ue22cs343bb1_openmp_assignment_tpu.obs import recording
+from ue22cs343bb1_openmp_assignment_tpu.obs import burnrate, events, recording
 from ue22cs343bb1_openmp_assignment_tpu.obs.clock import MonotonicClock
 from ue22cs343bb1_openmp_assignment_tpu.serve import (
     JobSpec, SpanBook, build_job_arrays, build_job_state, job_config,
@@ -146,7 +146,9 @@ class DaemonCore:
                  lane_weights: Optional[Dict[str, int]] = None,
                  clock=None, out_dir=None, keep_dumps: bool = True,
                  retain_results: int = protocol.DEFAULT_RETAIN_RESULTS,
-                 recorder: Optional[recording.RecordingWriter] = None):
+                 recorder: Optional[recording.RecordingWriter] = None,
+                 emitter: Optional[events.EventEmitter] = None,
+                 burn: Optional[burnrate.BurnRateMonitor] = None):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if max_buckets < 1:
@@ -169,6 +171,8 @@ class DaemonCore:
         self.keep_dumps = keep_dumps
         self.retain_results = retain_results
         self.recorder = recorder
+        self.emitter = emitter
+        self.burn = burn
         self.t_start = self.clock.now()
         self.book = SpanBook(self.clock)
         self.lanes: Dict[str, _Lane] = {
@@ -188,11 +192,26 @@ class DaemonCore:
         self.bucket_growths = 0
         self.queue_depth_peak = 0
         self.results_evicted = 0
+        self.stats_seq = 0
+        self.slo_alerts = 0
+        self._lane_hist: Dict[str, object] = {}
         self._terminal_order: List[str] = []
         self._quiesced_total = 0
         self._real_total = 0
         self._budget_total = 0
         self._rejected_total = 0
+
+    # lint: host
+    def _emit(self, kind: str, job: Optional[str] = None,
+              **fields) -> None:
+        """One live-ops event (obs.events) at the CURRENT clock time,
+        as an offset from core start. Always clock.now(), never a
+        scheduled submit stamp: event time is when the scheduler acted
+        (which also keeps the stream's t_s non-decreasing when an
+        open-loop driver back-stamps arrivals)."""
+        if self.emitter is not None:
+            self.emitter.emit(kind, self.clock.now() - self.t_start,
+                              job, **fields)
 
     # -- admission-side API (called by the socket handlers) ---------------
 
@@ -218,6 +237,8 @@ class DaemonCore:
             ln.rejected += 1
             self._rejected_total += 1
             self._status[spec.name] = "rejected"
+            self._emit("lane-reject", spec.name, lane=lane,
+                       reason="draining")
             self._retire(spec.name)
             return {**base, "ok": False, "status": "rejected",
                     "reason": "draining"}
@@ -228,6 +249,8 @@ class DaemonCore:
             ln.rejected += 1
             self._rejected_total += 1
             self._status[spec.name] = "rejected"
+            self._emit("lane-reject", spec.name, lane=lane,
+                       reason="queue-full", depth=ln.depth)
             self._retire(spec.name)
             return {**base, "ok": False, "status": "rejected",
                     "reason": f"lane {lane!r} queue full "
@@ -252,6 +275,8 @@ class DaemonCore:
             self.recorder.submit(
                 spec, lane, t - self.t_start,
                 sum(len(x.queue) for x in self.lanes.values()))
+        self._emit("submit-accepted", spec.name, lane=lane,
+                   depth=len(ln.queue))
         return {**base, "status": "queued"}
 
     # lint: host
@@ -297,6 +322,8 @@ class DaemonCore:
                 del self._status[old]
                 self.results.pop(old, None)
                 self.results_evicted += 1
+                self._emit("result-evicted", old,
+                           retain=self.retain_results)
         self.book.prune(self.retain_results)
 
     # -- scheduler side ----------------------------------------------------
@@ -379,6 +406,8 @@ class DaemonCore:
         b.admitted = victim.admitted
         self.buckets[(spec.protocol, grown[0], grown[1])] = b
         self.bucket_growths += 1
+        self._emit("bucket-growth", spec.name, bucket=b.label,
+                   grown_from=victim.label)
         return b
 
     # lint: host
@@ -425,6 +454,8 @@ class DaemonCore:
             self.book.running(spec.name, t)
             self.book.annotate(spec.name, bucket=b.label)
             self._status[spec.name] = "running"
+            self._emit("admitted", spec.name, lane=ln.name,
+                       bucket=b.label, wave=b.chunks, slot=slot)
 
     # lint: host
     def pump(self) -> bool:
@@ -502,9 +533,28 @@ class DaemonCore:
                 json.dumps({k: v for k, v in doc.items()
                             if k != "dumps"}, indent=2) + "\n")
         self.book.extracted(spec.name)
+        # spans() is in extraction order, so the span just closed by
+        # extracted() is the last one — its e2e feeds the per-lane
+        # mergeable histogram and the burn-rate monitor
+        span = self.book._done[-1]
+        e2e_s = float(span["e2e_s"])
+        if lane.name not in self._lane_hist:
+            from ue22cs343bb1_openmp_assignment_tpu.obs import timeseries
+            self._lane_hist[lane.name] = timeseries.LogHistogram()
+        self._lane_hist[lane.name].observe(e2e_s * 1e3)
         if self.recorder is not None:
             self.recorder.result(spec.name, t_end - self.t_start, ok,
                                  doc["digest"], doc["cycles"], b.label)
+        self._emit("quiesced", spec.name, lane=lane.name,
+                   bucket=b.label, ok=ok, cycles=doc["cycles"],
+                   e2e_ms=e2e_s * 1e3)
+        if self.burn is not None:
+            alert = self.burn.feed(t_end - self.t_start, e2e_s)
+            if alert is not None:
+                self.slo_alerts += 1
+                self._emit("slo-alert", spec.name,
+                           **{k: v for k, v in alert.items()
+                              if k != "t_s"})
         self.results[spec.name] = doc
         self._status[spec.name] = "done"
         self._quiesced_total += int(ok)
@@ -545,11 +595,16 @@ class DaemonCore:
         lane_lat = timeseries.lane_latency_summaries(self.book.spans())
         lanes = {}
         for name, ln in sorted(self.lanes.items()):
+            hist = self._lane_hist.get(name)
             lanes[name] = {
                 "weight": ln.weight, "depth": ln.depth,
                 "queued": len(ln.queue), "submitted": ln.submitted,
                 "admitted": ln.admitted, "rejected": ln.rejected,
                 "done": ln.done, "latency": lane_lat.get(name),
+                # unlike "latency" (a sliding window over RETAINED
+                # spans), the histogram is lifetime-exact and
+                # fleet-mergeable (fixed edges)
+                "hist": None if hist is None else hist.to_doc(),
             }
         buckets = []
         for key in sorted(self.buckets):
@@ -567,10 +622,15 @@ class DaemonCore:
         if done and self._max_shape is not None:
             n, t = self._max_shape
             single = 1.0 - self._real_total / (done * n * t)
+        # every snapshot gets the next seq — two stats docs from one
+        # daemon are totally ordered even when uptime_s ties (virtual
+        # clock, no wave between polls)
+        self.stats_seq += 1
         doc = {
             "schema": schema.DAEMON_STATS_SCHEMA_ID,
             "clock": self.clock.kind,
             "uptime_s": self.clock.now() - self.t_start,
+            "stats_seq": self.stats_seq,
             "draining": self.draining,
             "jobs": {
                 "submitted": sum(ln.submitted
@@ -596,6 +656,15 @@ class DaemonCore:
                 "submits": self.recorder.submits,
                 "results": self.recorder.results,
             }),
+            "events": (None if self.emitter is None else {
+                "path": self.emitter.path,
+                "ring": self.emitter.ring,
+                "seq": self.emitter.seq,
+                "dropped": self.emitter.dropped,
+            }),
+            "slo_alerts": self.slo_alerts,
+            "burnrate": (None if self.burn is None
+                         else self.burn.summary()),
             "padding_waste": (
                 1.0 - self._real_total / self._budget_total
                 if self._budget_total else None),
@@ -619,6 +688,20 @@ def attach_recorder(core: DaemonCore,
     core.recorder = recording.RecordingWriter(
         path, core.clock.kind, core.record_config())
     return core.recorder
+
+
+# lint: host
+def attach_emitter(core: DaemonCore, path=None,
+                   ring: int = events.DEFAULT_RING
+                   ) -> events.EventEmitter:
+    """Open a ``cache-sim/events/v1`` emitter (ring-only, or also
+    streamed to ``path`` — the ``--events-dir`` artifact) and attach
+    it to the core; every scheduler decision from here on is one
+    structured event the ``watch`` verb can push to clients."""
+    core.emitter = events.EventEmitter(
+        core.clock.kind, ring=ring, path=path,
+        config=core.record_config())
+    return core.emitter
 
 
 # lint: host

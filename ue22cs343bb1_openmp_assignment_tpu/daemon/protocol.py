@@ -29,6 +29,20 @@ Ops
              accepted submissions streamed, result digests written);
              ``recording`` is null when record mode is off.
 ``trace``    → the ``cache-sim/serve-trace/v1`` doc of completed jobs.
+``watch``    ``{"op": "watch", "interval_s": <poll>, "max_s": <stop
+             after>, "max_rows": <stop after>}`` — the ONE
+             long-lived op: the server acks
+             ``{"ok": true, "op": "watch", "streaming": true}`` and
+             then pushes NDJSON rows on the same connection —
+             ``{"op": "watch", "type": "event", "event": <cache-
+             sim/events/v1 row>}`` for every live-ops event and
+             ``{"op": "watch", "type": "stats", "stats": <stats
+             doc>}`` whenever the counters changed at a poll tick —
+             until the bound hits or the daemon stops, then one
+             ``{"op": "watch", "type": "end", "reason": ...}`` row,
+             after which the connection speaks plain request/response
+             again. Rows ride the event ring: a slow client sees a
+             ``seq`` gap, never a stalled scheduler.
 ``drain``    → stop admitting, flush queued + in-flight jobs, respond
              when idle.
 ``shutdown`` → respond, then stop the scheduler after the current
@@ -50,8 +64,12 @@ import socket
 from typing import Tuple
 
 #: every request op the server understands
-OPS = ("submit", "status", "result", "stats", "trace", "drain",
-       "shutdown", "ping")
+OPS = ("submit", "status", "result", "stats", "trace", "watch",
+       "drain", "shutdown", "ping")
+
+#: default watch-stream poll cadence (seconds): how often the server
+#: checks the event ring / stats counters for a watching client
+DEFAULT_WATCH_INTERVAL_S = 0.25
 
 #: the priority lanes and their default admission weights: the
 #: scheduler picks lanes by smooth weighted round-robin, so at full

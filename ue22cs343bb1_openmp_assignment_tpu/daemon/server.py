@@ -11,6 +11,13 @@ on the same condition until the core reports idle; ``shutdown``
 responds first, then stops the scheduler after the current chunk and
 removes the socket — a clean exit the check.sh smoke verifies leaves
 no orphaned process.
+
+The one exception to one-line-in-one-line-out is ``watch``: the
+handler thread acks, then PUSHES stats-deltas and live-ops events
+(obs.events ring, always attached) on its own connection until the
+client's bound hits — snapshots are taken under the server lock, the
+poll sleep is not, so a slow watcher falls behind the ring instead of
+stalling the scheduler.
 """
 # lint: host
 
@@ -19,11 +26,13 @@ from __future__ import annotations
 import os
 import socket
 import threading
+import time
 from typing import Optional
 
 from ue22cs343bb1_openmp_assignment_tpu.daemon import protocol
 from ue22cs343bb1_openmp_assignment_tpu.daemon.core import (
-    DaemonCore, attach_recorder)
+    DaemonCore, attach_emitter, attach_recorder)
+from ue22cs343bb1_openmp_assignment_tpu.obs import burnrate, events
 from ue22cs343bb1_openmp_assignment_tpu.serve import JobSpec
 
 #: scheduler poll tick when idle (seconds); submits wake it earlier
@@ -113,6 +122,12 @@ class DaemonServer:
                     continue
                 try:
                     req = protocol.decode(line)
+                    if req.get("op") == "watch":
+                        # the one long-lived op: ack + push rows on
+                        # this connection, then fall back into the
+                        # plain request/response loop
+                        self._watch(req, f)
+                        continue
                     resp = self._handle(req)
                 except Exception as e:  # noqa: BLE001 — wire boundary
                     resp = protocol.error(None, str(e))
@@ -128,6 +143,83 @@ class DaemonServer:
                 conn.close()
             except OSError:
                 pass
+
+    # lint: host
+    def _stats_sig(self) -> tuple:
+        """A cheap change-signature over the core's lifetime counters
+        — computed WITHOUT calling stats(), so idle watch polls never
+        bump ``stats_seq`` (a stats row is pushed only when something
+        actually moved)."""
+        c = self.core
+        return (sum(ln.submitted for ln in c.lanes.values()),
+                c._rejected_total,
+                sum(ln.done for ln in c.lanes.values()),
+                sum(len(ln.queue) for ln in c.lanes.values()),
+                c.chunks, c.bucket_growths, c.results_evicted,
+                c.slo_alerts, c.draining)
+
+    # lint: host
+    def _watch(self, req: dict, f) -> None:
+        """Stream stats-deltas + live-ops events to one client until
+        its ``max_rows``/``max_s`` bound hits or the daemon stops.
+        Holds the server lock only to snapshot; the sleep is unlocked,
+        so a slow watcher never stalls the scheduler — it just falls
+        behind the ring and sees a ``seq`` gap."""
+        interval = float(req.get("interval_s",
+                                 protocol.DEFAULT_WATCH_INTERVAL_S))
+        interval = max(0.01, interval)
+        max_rows = req.get("max_rows")
+        max_s = req.get("max_s")
+        with self.lock:
+            if self.core.emitter is None:
+                attach_emitter(self.core)    # ring-only, late client
+            em = self.core.emitter
+            cursor = em.seq                  # new events only
+            sig = self._stats_sig()
+            stats = self.core.stats()        # the baseline snapshot
+        f.write(protocol.encode({"ok": True, "op": "watch",
+                                 "streaming": True,
+                                 "interval_s": interval,
+                                 "cursor": cursor}))
+        f.write(protocol.encode({"ok": True, "op": "watch",
+                                 "type": "stats", "stats": stats}))
+        f.flush()
+        rows = 1
+        t0 = time.monotonic()
+        reason = "stopped"
+        while not self._stop.is_set():
+            if max_rows is not None and rows >= int(max_rows):
+                reason = "max-rows"
+                break
+            if (max_s is not None
+                    and time.monotonic() - t0 >= float(max_s)):
+                reason = "max-s"
+                break
+            self._stop.wait(interval)
+            with self.lock:
+                evs = em.since(cursor)
+                if evs:
+                    cursor = evs[-1]["seq"] + 1
+                new_sig = self._stats_sig()
+                stats = (self.core.stats() if new_sig != sig
+                         else None)
+                sig = new_sig
+            for ev in evs:
+                f.write(protocol.encode({"ok": True, "op": "watch",
+                                         "type": "event",
+                                         "event": ev}))
+                rows += 1
+            if stats is not None:
+                f.write(protocol.encode({"ok": True, "op": "watch",
+                                         "type": "stats",
+                                         "stats": stats}))
+                rows += 1
+            if evs or stats is not None:
+                f.flush()
+        f.write(protocol.encode({"ok": True, "op": "watch",
+                                 "type": "end", "reason": reason,
+                                 "rows": rows, "cursor": cursor}))
+        f.flush()
 
     # lint: host
     def _handle(self, req: dict) -> dict:
@@ -161,6 +253,12 @@ class DaemonServer:
             with self.lock:
                 return {"ok": True, "op": "trace",
                         "trace": self.core.trace_doc()}
+        if op == "watch":
+            # unreachable via _serve_conn (special-cased there); keep
+            # a direct _handle("watch") from falling into shutdown
+            return protocol.error(
+                "watch", "watch is a streaming op, handled on the "
+                         "connection")
         if op == "drain":
             with self.wake:
                 self.core.drain()
@@ -249,6 +347,25 @@ def main(argv=None) -> int:
                          "(cache-sim/recording/v1) — replay the "
                          "captured traffic later with "
                          "`cache-sim replay DIR`")
+    ap.add_argument("--events-dir", default=None, metavar="DIR",
+                    help="also stream every live-ops event "
+                         "(cache-sim/events/v1: submit-accepted, "
+                         "admitted, quiesced, lane-reject, "
+                         "result-evicted, bucket-growth, slo-alert) "
+                         "to DIR/events.jsonl; the in-memory ring "
+                         "that feeds `watch` clients is always on")
+    ap.add_argument("--events-ring", type=int,
+                    default=events.DEFAULT_RING, metavar="N",
+                    help="in-memory event ring bound (default "
+                         f"{events.DEFAULT_RING} rows); a watch "
+                         "client that falls behind sees a seq gap")
+    ap.add_argument("--burn-slo", default=None, metavar="SPEC",
+                    help="continuous burn-rate SLO, e.g. "
+                         '"5ms,objective=0.99,fast=60,slow=300,'
+                         'factor=2": every finished job is one '
+                         "sample; when BOTH windows burn the error "
+                         "budget at factor x, one slo-alert event is "
+                         "injected into the stream")
     ap.add_argument("--virtual-clock", action="store_true",
                     help="run the scheduler on the deterministic "
                          "VirtualClock (time advances per wave, not "
@@ -283,6 +400,15 @@ def main(argv=None) -> int:
         if not args.quiet:
             print(f"daemon: recording traffic to {recorder.path}",
                   flush=True)
+    # the event ring is always on (watch clients need it); --events-dir
+    # additionally streams every row to disk
+    emitter = attach_emitter(core, args.events_dir,
+                             ring=args.events_ring)
+    if args.events_dir and not args.quiet:
+        print(f"daemon: streaming events to {emitter.path}",
+              flush=True)
+    if args.burn_slo:
+        core.burn = burnrate.monitor_from_spec(args.burn_slo)
     server = DaemonServer(core, args.addr, quiet=args.quiet)
     try:
         return server.run()
@@ -292,6 +418,8 @@ def main(argv=None) -> int:
     finally:
         if core.recorder is not None:
             core.recorder.close()
+        if core.emitter is not None:
+            core.emitter.close()
 
 
 if __name__ == "__main__":

@@ -527,6 +527,154 @@ def validate_daemon_stats(doc: dict) -> dict:
                         or v < 0):
                     errs.append(f"recording.{k} must be a "
                                 f"non-negative int, got {v!r}")
+    # -- live-ops-plane additions, validated WHEN PRESENT: a pre-ops
+    # v1 doc (no stats_seq/hist/events) keeps validating unchanged,
+    # the backcompat matrix in tests/test_ops_plane.py pins both ways
+    v = doc.get("uptime_s")
+    if v is not None and (not isinstance(v, (int, float))
+                          or isinstance(v, bool) or v < 0):
+        errs.append(f"uptime_s must be a non-negative number, got {v!r}")
+    for k in ("stats_seq", "slo_alerts"):
+        if k in doc:
+            v = doc[k]
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"{k} must be a non-negative int, "
+                            f"got {v!r}")
+    if isinstance(lanes, dict):
+        for name, lane in lanes.items():
+            hist = lane.get("hist") if isinstance(lane, dict) else None
+            if hist is None:
+                continue
+            h_errs = _hist_errs(hist, f"lane {name}: hist")
+            errs.extend(h_errs)
+    ev = doc.get("events")
+    if ev is not None:
+        if not isinstance(ev, dict):
+            errs.append("events must be None or a dict "
+                        "{path, ring, seq, dropped}")
+        else:
+            for k in ("ring", "seq", "dropped"):
+                v = ev.get(k)
+                if (not isinstance(v, int) or isinstance(v, bool)
+                        or v < 0):
+                    errs.append(f"events.{k} must be a non-negative "
+                                f"int, got {v!r}")
     if errs:
         raise ValueError("invalid daemon stats:\n  " + "\n  ".join(errs))
+    return doc
+
+
+# lint: host
+def _hist_errs(hist, where: str) -> list:
+    """Structural errors of one mergeable-histogram doc
+    (obs.timeseries.LogHistogram.to_doc): counts must be one longer
+    than edges (the overflow bucket) and their total must equal
+    ``count`` — the invariant the exact fleet merge relies on."""
+    if not isinstance(hist, dict):
+        return [f"{where}: must be None or a dict"]
+    errs = []
+    edges = hist.get("edges_ms")
+    counts = hist.get("counts")
+    if (not isinstance(edges, list) or not edges
+            or any(not isinstance(e, (int, float)) or isinstance(e, bool)
+                   for e in edges)
+            or any(b <= a for a, b in zip(edges, edges[1:]))):
+        errs.append(f"{where}: edges_ms must be a strictly increasing "
+                    f"number list")
+    if (not isinstance(counts, list)
+            or any(not isinstance(c, int) or isinstance(c, bool) or c < 0
+                   for c in counts)):
+        errs.append(f"{where}: counts must be a list of non-negative "
+                    f"ints")
+    elif isinstance(edges, list) and len(counts) != len(edges) + 1:
+        errs.append(f"{where}: counts must have len(edges_ms) + 1 "
+                    f"entries (the overflow bucket), got {len(counts)} "
+                    f"for {len(edges)} edges")
+    n = hist.get("count")
+    if not isinstance(n, int) or isinstance(n, bool) or n < 0:
+        errs.append(f"{where}: count must be a non-negative int")
+    elif isinstance(counts, list) and all(
+            isinstance(c, int) and not isinstance(c, bool) and c >= 0
+            for c in counts) and sum(counts) != n:
+        errs.append(f"{where}: count ({n}) != sum(counts) "
+                    f"({sum(counts)})")
+    s = hist.get("sum_ms")
+    if (not isinstance(s, (int, float)) or isinstance(s, bool)
+            or s < 0):
+        errs.append(f"{where}: sum_ms must be a non-negative number")
+    return errs
+
+
+# -- fleet view: N replicas' stats merged -----------------------------------
+
+FLEET_SCHEMA_ID = "cache-sim/fleet/v1"
+
+#: required top-level keys of a fleet doc (obs.fleet.merge_stats):
+#: lifetime counters are EXACT sums over the replicas, gauges are the
+#: fleet-meaningful reduction (max uptime, peak depth, any draining)
+_FLEET_TOP_KEYS = ("schema", "replicas", "jobs", "lanes", "buckets",
+                   "chunks", "busy_s", "drain_rate_jobs_per_s",
+                   "mb_dropped", "mid_wave_swaps", "bucket_growths",
+                   "results_evicted", "slo_alerts", "uptime_s",
+                   "queue_depth_peak", "draining", "per_replica")
+
+
+# lint: host
+def validate_fleet(doc: dict) -> dict:
+    """Structural check of a ``cache-sim/fleet/v1`` merged stats doc
+    (the ``cache-sim top`` aggregator). Same contract as
+    :func:`validate_daemon_stats`."""
+    errs = []
+    if not isinstance(doc, dict):
+        raise ValueError(f"fleet doc must be a dict, "
+                         f"got {type(doc).__name__}")
+    if doc.get("schema") != FLEET_SCHEMA_ID:
+        errs.append(f"schema must be {FLEET_SCHEMA_ID!r}, "
+                    f"got {doc.get('schema')!r}")
+    for k in _FLEET_TOP_KEYS:
+        if k not in doc:
+            errs.append(f"missing key: {k}")
+    n = doc.get("replicas")
+    if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+        errs.append(f"replicas must be a positive int, got {n!r}")
+    jobs = doc.get("jobs")
+    if not isinstance(jobs, dict):
+        errs.append("jobs must be a dict")
+    else:
+        for k in _DAEMON_JOB_KEYS:
+            v = jobs.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"jobs.{k} must be a non-negative int, "
+                            f"got {v!r}")
+    lanes = doc.get("lanes")
+    if not isinstance(lanes, dict):
+        errs.append("lanes must be a dict")
+    else:
+        for name, lane in lanes.items():
+            if not isinstance(lane, dict):
+                errs.append(f"lane {name}: not a dict")
+                continue
+            for k in ("queued", "submitted", "admitted", "rejected",
+                      "done"):
+                v = lane.get(k)
+                if (not isinstance(v, int) or isinstance(v, bool)
+                        or v < 0):
+                    errs.append(f"lane {name}: {k} must be a "
+                                f"non-negative int, got {v!r}")
+            if lane.get("hist") is not None:
+                errs.extend(_hist_errs(lane["hist"],
+                                       f"lane {name}: hist"))
+    per = doc.get("per_replica")
+    if not isinstance(per, list) or (isinstance(n, int)
+                                     and not isinstance(n, bool)
+                                     and len(per or []) != n):
+        errs.append("per_replica must be a list with one row per "
+                    "replica")
+    else:
+        for i, row in enumerate(per):
+            if not isinstance(row, dict) or "replica" not in row:
+                errs.append(f"per_replica[{i}]: must be a dict with "
+                            f"a 'replica' label")
+    if errs:
+        raise ValueError("invalid fleet doc:\n  " + "\n  ".join(errs))
     return doc

@@ -121,8 +121,11 @@ def percentile(xs: Sequence[float], q: float) -> float:
     that actually happened to a job, never an interpolated value —
     which also keeps virtual-clock soak docs byte-identical (no
     float interpolation to wobble)."""
-    if not xs:
-        raise ValueError("percentile of an empty sample")
+    # len(), not truthiness: a numpy sample array would make `not xs`
+    # raise the ambiguous-truth error instead of the clear one below
+    if len(xs) == 0:
+        raise ValueError("percentile of an empty sample: no jobs "
+                         "completed, so there is no latency to rank")
     if not 0 <= q <= 100:
         raise ValueError(f"q must be in [0, 100], got {q}")
     s = sorted(xs)
@@ -153,6 +156,76 @@ def latency_summary(lat_s: Sequence[float],
     if queue_depth_peak is not None:
         doc["queue_depth_peak"] = int(queue_depth_peak)
     return doc
+
+
+# -- mergeable latency histogram (the fleet-exact aggregate) ---------------
+
+#: fixed log-spaced latency bucket upper edges in milliseconds —
+#: 1 µs .. ~2.2 min doubling, identical for EVERY histogram instance.
+#: Fixed on purpose: two replicas' histograms share edges by
+#: construction, so a fleet merge is an exact elementwise count sum
+#: (never a lossy re-bucketing), and the Prometheus ``le`` label set
+#: is stable across the fleet. Each edge is a power of two times an
+#: exact binary float, so the doc round-trips JSON byte-identically.
+HIST_EDGES_MS = tuple(0.001 * (1 << i) for i in range(28))
+
+
+class LogHistogram:
+    """Streaming latency histogram over :data:`HIST_EDGES_MS`.
+
+    ``counts[i]`` holds samples with ``value <= HIST_EDGES_MS[i]``
+    (and above the previous edge); the final extra slot is the
+    open-ended overflow bucket. ``count``/``sum_ms`` ride along so
+    Prometheus exposition gets ``_count``/``_sum`` for free.
+    """
+
+    # lint: host
+    def __init__(self):
+        self.counts: List[int] = [0] * (len(HIST_EDGES_MS) + 1)
+        self.count = 0
+        self.sum_ms = 0.0
+
+    # lint: host
+    def observe(self, ms: float) -> None:
+        ms = float(ms)
+        self.count += 1
+        self.sum_ms += ms
+        for i, edge in enumerate(HIST_EDGES_MS):
+            if ms <= edge:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    # lint: host
+    def to_doc(self) -> dict:
+        return {"edges_ms": list(HIST_EDGES_MS),
+                "counts": list(self.counts),
+                "count": self.count, "sum_ms": self.sum_ms}
+
+
+# lint: host
+def merge_hist_docs(docs: Sequence[dict]) -> Optional[dict]:
+    """Exact cross-replica merge of :class:`LogHistogram` docs:
+    identical fixed edges → the merged histogram is the elementwise
+    count sum (the fleet aggregator's per-lane latency view). Raises
+    on mismatched edges; None when no doc survives filtering."""
+    docs = [d for d in docs if d]
+    if not docs:
+        return None
+    edges = docs[0]["edges_ms"]
+    counts = [0] * len(docs[0]["counts"])
+    count = 0
+    sum_ms = 0.0
+    for d in docs:
+        if d["edges_ms"] != edges or len(d["counts"]) != len(counts):
+            raise ValueError("histogram docs have mismatched bucket "
+                             "edges — refusing a lossy merge")
+        for i, c in enumerate(d["counts"]):
+            counts[i] += int(c)
+        count += int(d["count"])
+        sum_ms += float(d["sum_ms"])
+    return {"edges_ms": list(edges), "counts": counts,
+            "count": count, "sum_ms": sum_ms}
 
 
 # lint: host

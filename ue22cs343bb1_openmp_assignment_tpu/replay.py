@@ -107,7 +107,7 @@ def replay_recording(rec: dict, daemon: Optional[str] = None,
                      queue_capacity: Optional[int] = None,
                      wave_s: float = 1e-3, out_dir=None,
                      timeout_s: float = 300.0,
-                     quiet: bool = True) -> dict:
+                     quiet: bool = True, burn=None) -> dict:
     """Re-drive a loaded recording; returns a ``cache-sim/soak/v1``-
     shaped doc (``transport: "replay"``) extended with the digest
     audit (``digests_matched`` / ``digest_mismatches``) and the
@@ -127,7 +127,7 @@ def replay_recording(rec: dict, daemon: Optional[str] = None,
         doc = soak_mod.soak_daemon(
             [(t, spec) for t, spec, _ in sched], daemon,
             arrival_rate=rate, timeout_s=timeout_s, quiet=quiet,
-            lanes=[lane for _, _, lane in sched])
+            lanes=[lane for _, _, lane in sched], burn=burn)
         doc["transport"] = "replay-daemon"
         # dumps do not cross the socket; audit what the daemon reports
         doc["digests_matched"] = None
@@ -137,7 +137,8 @@ def replay_recording(rec: dict, daemon: Optional[str] = None,
                               slots=slots, chunk=chunk,
                               max_cycles=max_cycles,
                               queue_capacity=queue_capacity,
-                              wave_s=wave_s, out_dir=out_dir)
+                              wave_s=wave_s, out_dir=out_dir,
+                              burn=burn)
     doc["recorded_latency"] = recording.latency_block(
         rec, arrival_rate=rate)
     doc["recorded_jobs"] = len(sched)
@@ -149,7 +150,7 @@ def replay_recording(rec: dict, daemon: Optional[str] = None,
 def _replay_in_proc(rec: dict, sched, rate: float, recorded: dict,
                     slots=None, chunk=None, max_cycles=None,
                     queue_capacity=None, wave_s: float = 1e-3,
-                    out_dir=None) -> dict:
+                    out_dir=None, burn=None) -> dict:
     from ue22cs343bb1_openmp_assignment_tpu.daemon.core import (
         DaemonCore, drive)
     from ue22cs343bb1_openmp_assignment_tpu.daemon import protocol
@@ -170,7 +171,7 @@ def _replay_in_proc(rec: dict, sched, rate: float, recorded: dict,
         lane_depth=int(cfg.get("lane_depth",
                                protocol.DEFAULT_LANE_DEPTH)),
         lane_weights=cfg.get("lane_weights"),
-        clock=VirtualClock(wave_s=wave_s),
+        clock=VirtualClock(wave_s=wave_s), burn=burn,
         out_dir=out_dir, keep_dumps=True,
         # replay must never evict: the digest audit and the span-based
         # latency block need every job's result
@@ -234,6 +235,7 @@ def _replay_in_proc(rec: dict, sched, rate: float, recorded: dict,
                  for name, d in sorted(core.results.items())},
         "waves": [],
         "trace": core.trace_doc(),
+        "burnrate": None if burn is None else burn.summary(),
     }
 
 
@@ -332,6 +334,11 @@ def main(argv=None) -> int:
     ap.add_argument("--incident-dir", default="replay_incident",
                     help="where an SLO breach dumps its incident "
                          "(default ./replay_incident)")
+    ap.add_argument("--burn-slo", default=None, metavar="SPEC",
+                    help="multi-window burn-rate SLO on the replayed "
+                         'run, e.g. "5ms,objective=0.99,fast=60,'
+                         'slow=300,factor=2" (obs.burnrate); an '
+                         f"alert exits {soak_mod.EXIT_SLO_BREACH}")
     ap.add_argument("--shrink", action="store_true",
                     help="on an SLO breach, ddmin the recording's JOB "
                          "LIST to a minimal subset that still "
@@ -349,6 +356,10 @@ def main(argv=None) -> int:
     if args.cpu:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
     slo = soak_mod.parse_slo(args.slo) if args.slo else None
+    burn = None
+    if args.burn_slo:
+        from ue22cs343bb1_openmp_assignment_tpu.obs import burnrate
+        burn = burnrate.monitor_from_spec(args.burn_slo)
     if args.shrink and not slo:
         ap.error("--shrink needs --slo: the shrink predicate is "
                  "'this subset still breaches the SLO on replay'")
@@ -370,7 +381,7 @@ def main(argv=None) -> int:
     doc = replay_recording(
         rec, daemon=args.daemon, slots=args.slots, chunk=args.chunk,
         max_cycles=args.max_cycles, queue_capacity=args.queue_capacity,
-        wave_s=args.wave_s, timeout_s=args.timeout)
+        wave_s=args.wave_s, timeout_s=args.timeout, burn=burn)
     report = None
     if doc["latency"] is not None \
             and doc["recorded_latency"] is not None:
@@ -409,6 +420,21 @@ def main(argv=None) -> int:
         if args.shrink:
             print("replay: --shrink skipped (no SLO breach to "
                   "preserve)")
+    if burn is not None and burn.breached():
+        import sys
+        for a in burn.alerts:
+            print(f"replay: BURN-RATE ALERT at t={a['t_s']:.3f}s: "
+                  f"fast {a['fast_burn']:.1f}x / slow "
+                  f"{a['slow_burn']:.1f}x the {a['objective']:.3%} "
+                  f"error budget (> {a['threshold_ms']}ms, factor "
+                  f"{a['factor']})", file=sys.stderr)
+        soak_mod.dump_incident(
+            args.incident_dir, doc,
+            [{"metric": "burn-rate", **a} for a in burn.alerts],
+            rec=rec)
+        print(f"replay: incident dumped to {args.incident_dir}",
+              file=sys.stderr)
+        return soak_mod.EXIT_SLO_BREACH
     if doc["digest_mismatches"]:
         print(f"replay: {len(doc['digest_mismatches'])} job(s) with "
               "DIVERGENT dumps vs the recording")

@@ -175,7 +175,7 @@ def soak(arrivals, slots: int = 4, slot_nodes: Optional[int] = None,
          slot_trace_len: Optional[int] = None, chunk: int = 32,
          max_cycles: int = 100_000, queue_capacity: int = 64,
          arrival_rate: Optional[float] = None, clock=None,
-         quiet: bool = True) -> dict:
+         quiet: bool = True, burn=None) -> dict:
     """Run an open-loop arrival schedule ``[(t_offset_s, JobSpec)]``
     through the batched wave machinery; returns the
     ``cache-sim/soak/v1`` summary doc (latency block, queue/occupancy
@@ -185,6 +185,11 @@ def soak(arrivals, slots: int = 4, slot_nodes: Optional[int] = None,
     static jit argument, so a mixed-protocol stream would interleave
     two wave sequences and the drain-rate verdict would compare apples
     to oranges.
+
+    ``burn`` (an obs.burnrate.BurnRateMonitor) turns the run into a
+    CONTINUOUS SLO check: every extracted job feeds one latency
+    sample, and ``doc["burnrate"]`` carries the streaming verdict —
+    the --slo end-of-run gate's complement.
     """
     import sys
 
@@ -293,6 +298,11 @@ def soak(arrivals, slots: int = 4, slot_nodes: Optional[int] = None,
                 "cycles": int(np.asarray(st.index_state(host, i).cycle)),
             }
             book.extracted(spec.name)
+            if burn is not None:
+                # spans() is in extraction order: the one extracted()
+                # just closed is last — its e2e is the burn sample
+                burn.feed(t_wave_end - t_start,
+                          book.spans()[-1]["e2e_s"])
             # the finished (quiescent = fixpoint) state stays in place
             # until the slot is refilled — same contract as serve
             occupant[i] = None
@@ -328,6 +338,7 @@ def soak(arrivals, slots: int = 4, slot_nodes: Optional[int] = None,
         "series_summary": series_summary,
         "verdict": backpressure_verdict(arrival_rate, drain,
                                         series_summary),
+        "burnrate": None if burn is None else burn.summary(),
         "jobs": job_docs,
         "waves": waves,
         "trace": serve_trace_doc(spans, clock.kind),
@@ -341,7 +352,7 @@ def soak_daemon(arrivals, addr: str,
                 lane_mix: Tuple[str, ...] = ("interactive", "batch"),
                 poll_s: float = 0.002, timeout_s: float = 300.0,
                 prefix: str = "", quiet: bool = True,
-                lanes: Optional[List[str]] = None) -> dict:
+                lanes: Optional[List[str]] = None, burn=None) -> dict:
     """Drive the same open-loop arrival schedule through a RUNNING
     daemon's socket instead of in-process waves.
 
@@ -430,7 +441,12 @@ def soak_daemon(arrivals, addr: str,
                 r = client.result(name)
                 if r.get("status") == "done":
                     t_sched, lane = outstanding.pop(name)
-                    e2e[name] = (clock.now() - t_sched, lane)
+                    t_done = clock.now()
+                    e2e[name] = (t_done - t_sched, lane)
+                    if burn is not None:
+                        # client-observed sample on the client clock —
+                        # the continuous twin of the headline latency
+                        burn.feed(t_done - t_start, t_done - t_sched)
                     done[name] = {
                         "quiesced": bool(r["quiesced"]),
                         "lane": r["lane"], "bucket": r["bucket"],
@@ -482,6 +498,7 @@ def soak_daemon(arrivals, addr: str,
         "series_summary": series_summary,
         "verdict": backpressure_verdict(arrival_rate, drain,
                                         series_summary),
+        "burnrate": None if burn is None else burn.summary(),
         "daemon_stats": stats,
         "jobs": done,
         "waves": [],
@@ -682,6 +699,14 @@ def main(argv=None) -> int:
                     help='latency SLO, e.g. "p95=5,p99=20" (ms); a '
                          f'breach exits {EXIT_SLO_BREACH} and dumps '
                          'an incident dir')
+    ap.add_argument("--burn-slo", default=None, metavar="SPEC",
+                    help="CONTINUOUS burn-rate SLO (obs.burnrate), "
+                         'e.g. "5ms,objective=0.99,fast=60,slow=300,'
+                         'factor=4": every finished job is one '
+                         "streaming sample; an alert (both windows "
+                         "burning the error budget at factor x) "
+                         f"exits {EXIT_SLO_BREACH} — the streaming "
+                         "complement of the end-of-run --slo gate")
     ap.add_argument("--incident-dir", default="soak_incident",
                     help="where an SLO breach dumps its incident "
                          "(default ./soak_incident)")
@@ -696,6 +721,10 @@ def main(argv=None) -> int:
     if args.cpu:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
     slo = parse_slo(args.slo) if args.slo else None
+    burn = None
+    if args.burn_slo:
+        from ue22cs343bb1_openmp_assignment_tpu.obs import burnrate
+        burn = burnrate.monitor_from_spec(args.burn_slo)
     if args.daemon and args.virtual_clock:
         ap.error("--daemon measures real client-observed latency over "
                  "the socket; it cannot run on --virtual-clock "
@@ -718,7 +747,8 @@ def main(argv=None) -> int:
         doc = soak_daemon(arrivals, args.daemon,
                           arrival_rate=args.arrival_rate,
                           lane_mix=lane_mix, timeout_s=args.timeout,
-                          prefix=f"s{args.seed}.", quiet=False)
+                          prefix=f"s{args.seed}.", quiet=False,
+                          burn=burn)
     else:
         clock = (VirtualClock(wave_s=args.wave_s)
                  if args.virtual_clock else MonotonicClock())
@@ -726,7 +756,7 @@ def main(argv=None) -> int:
                    max_cycles=args.max_cycles,
                    queue_capacity=args.queue_capacity,
                    arrival_rate=args.arrival_rate, clock=clock,
-                   quiet=False)
+                   quiet=False, burn=burn)
     if args.out:
         pathlib.Path(args.out).write_text(
             json.dumps(doc, indent=2) + "\n")
@@ -764,6 +794,20 @@ def main(argv=None) -> int:
             print(f"soak: incident dumped to {args.incident_dir}",
                   file=sys.stderr)
             return EXIT_SLO_BREACH
+    if burn is not None and burn.breached():
+        import sys
+        for a in burn.alerts:
+            print(f"soak: BURN-RATE ALERT at t={a['t_s']:.3f}s: "
+                  f"fast {a['fast_burn']:.1f}x / slow "
+                  f"{a['slow_burn']:.1f}x the {a['objective']:.3%} "
+                  f"error budget (> {a['threshold_ms']}ms, factor "
+                  f"{a['factor']})", file=sys.stderr)
+        dump_incident(args.incident_dir, doc,
+                      [{"metric": "burn-rate", **a}
+                       for a in burn.alerts])
+        print(f"soak: incident dumped to {args.incident_dir}",
+              file=sys.stderr)
+        return EXIT_SLO_BREACH
     return 0 if doc["jobs_quiesced"] == doc["jobs_total"] else 1
 
 
