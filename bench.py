@@ -395,6 +395,15 @@ def main():
                          "transaction-tracer overhead bench; compare "
                          "against a plain async capture with "
                          "bench-diff (PERF.md)")
+    ap.add_argument("--coherence-profile", action="store_true",
+                    help="async engine: measure the run under the "
+                         "coherence-profiler counter plane "
+                         "(ops.step.run_cycles_profile: per-line miss "
+                         "taxonomy + invalidation/migration "
+                         "attribution folded into the scan) — the "
+                         "profiler overhead bench; compare against a "
+                         "plain async capture with bench-diff "
+                         "(PERF.md)")
     ap.add_argument("--reps", type=int, default=3,
                     help="timed repetitions; the median is reported")
     ap.add_argument("--procedural", default=True,
@@ -720,6 +729,23 @@ def main():
                 stop_on_quiescence=False)
             return final
 
+    if args.coherence_profile:
+        if args.engine != "async" or args.ledger or args.sharded:
+            print("error: --coherence-profile measures the async "
+                  "engine's profiler counter plane; use --engine "
+                  "async without --ledger/--sharded", file=sys.stderr)
+            return 2
+        from ue22cs343bb1_openmp_assignment_tpu.ops.step import (
+            run_cycles_profile)
+        # same discipline as --ledger: the profiled replay runs the
+        # fixed cycle count the plain run needs to quiesce
+        prof_cycles = steps(run_chunked_to_quiescence(
+            cfg, st0, args.chunk, max_cycles))
+
+        def runner(s):
+            final, _ = run_cycles_profile(cfg, s, prof_cycles)
+            return final
+
     n_dev = 1
     if args.sharded:
         # multi-chip mode: the node axis shards over every attached
@@ -916,12 +942,14 @@ def main():
                           if args.sharded and args.engine == "async"
                           else None),
             "ledger": bool(args.ledger),
+            "coherence_profile": bool(args.coherence_profile),
             "platform": jax.devices()[0].platform,
             "smoke": bool(args.smoke),
         }
         doc = history.entry(
             label=(f"{args.engine}@{args.nodes}"
-                   + ("+ledger" if args.ledger else "")),
+                   + ("+ledger" if args.ledger else "")
+                   + ("+cohprof" if args.coherence_profile else "")),
             source="bench.py",
             result=result, extra=extra, config=fingerprint,
             sha=history.git_sha(os.path.dirname(

@@ -482,6 +482,35 @@ print(f"ops smoke: ok ({len(rows)} watch rows, "
 PY
 rm -rf "$OPS_DIR"
 
+# Profile smoke (30s box, obs v8): the coherence profiler classifies
+# the mini fixture and emits a validated cache-sim/profile/v1 doc;
+# byte-identical across two runs (the profiled replay is
+# deterministic by contract); and a false_sharing_vars run must come
+# out dominant=false_sharing with every miss accounted to a class —
+# the classifier's positive control.
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.cli \
+    profile mini --tests-root tests/fixtures \
+    --json --out /tmp/_prof_smoke_a.json
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.cli \
+    profile mini --tests-root tests/fixtures \
+    --json --out /tmp/_prof_smoke_b.json
+cmp /tmp/_prof_smoke_a.json /tmp/_prof_smoke_b.json
+timeout -k 5 30 python -m ue22cs343bb1_openmp_assignment_tpu.cli \
+    profile --workload false_sharing_vars --nodes 8 --trace-len 32 \
+    --json --out /tmp/_prof_smoke_fs.json
+timeout -k 5 30 python - <<'PY'
+import json
+from ue22cs343bb1_openmp_assignment_tpu.obs import cohprof
+mini = cohprof.validate(json.load(open("/tmp/_prof_smoke_a.json")))
+assert mini["sharing"]["classified_lines"] > 0, mini["sharing"]
+fs = cohprof.validate(json.load(open("/tmp/_prof_smoke_fs.json")))
+assert fs["sharing"]["dominant"] == "false_sharing", fs["sharing"]
+assert sum(fs["miss_classes"].values()) > 0, fs["miss_classes"]
+print("profile smoke: ok (mini classified "
+      f"{mini['sharing']['classified_lines']} lines, deterministic; "
+      f"false-sharing positive dominant={fs['sharing']['dominant']})")
+PY
+
 # RDMA-transport smoke (30s box): on 8 virtual CPU devices the Pallas
 # remote-DMA ring router (interpret mode — the CPU CI correctness
 # contract, parallel/rdma_comm) must bucket and exchange lanes

@@ -1,0 +1,387 @@
+"""Coherence profiler (ISSUE 20 tentpole): per-line miss taxonomy on a
+hand-built trace, schema validate/reject matrix, profile-plane-off
+bit-parity with all three engines, the workload fingerprint matrix,
+flight-incident embedding, and the measured deep-engine ghost-poison
+window.
+
+The hand trace is the profiler's ground truth: two nodes, six
+instructions, every miss class exercised exactly once or twice by
+construction (serialized via issue_delay so the interleaving is
+pinned) — see test_hand_trace_miss_taxonomy for the script.
+"""
+
+import copy
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ue22cs343bb1_openmp_assignment_tpu import cli
+from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
+from ue22cs343bb1_openmp_assignment_tpu.models.system import (
+    CoherenceSystem)
+from ue22cs343bb1_openmp_assignment_tpu.models.transactional import (
+    TransactionalSystem)
+from ue22cs343bb1_openmp_assignment_tpu.obs import cohprof, schema
+from ue22cs343bb1_openmp_assignment_tpu.ops import step
+from ue22cs343bb1_openmp_assignment_tpu.types import Op
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+# the hand-built ground-truth trace: addresses A (home 0, block 0) and
+# B (home 1, block 0) share cache index 0 (block % cache_size), so
+# node 0's read of B evicts its modified A copy.
+#
+#   t=0   n0  W A   cold write miss, A -> M at node 0
+#   t=20  n0  R B   cold read miss; evicts A (dirty writeback)
+#   t=40  n0  R A   CONFLICT-EVICTION miss (tag B at A's index)
+#   t=50  n1  R A   cold read miss, A shared
+#   t=60  n0  W A   UPGRADE (S write hit) -> INV to node 1, fan-out 1
+#   t=80  n1  R A   COHERENCE-INVALIDATION miss (tag match, INVALID)
+A_ADDR, B_ADDR = 0x00, 0x10
+HAND_TRACES = [
+    [(Op.WRITE, A_ADDR, 11), (Op.READ, B_ADDR, 0),
+     (Op.READ, A_ADDR, 0), (Op.WRITE, A_ADDR, 22)],
+    [(Op.READ, A_ADDR, 0), (Op.READ, A_ADDR, 0)],
+]
+
+
+def _hand_system(cfg):
+    return CoherenceSystem.from_traces(
+        cfg, HAND_TRACES,
+        issue_delay=np.array([0, 50], np.int32),
+        issue_period=np.array([20, 30], np.int32))
+
+
+@pytest.mark.parametrize("cfg", [
+    SystemConfig.reference(num_nodes=2),   # mailbox INV attribution
+    SystemConfig.scale(num_nodes=2),       # scatter INV attribution
+], ids=["mailbox", "scatter"])
+def test_hand_trace_miss_taxonomy(cfg):
+    sysm = _hand_system(cfg)
+    fin = sysm.run(400)
+    assert fin.quiescent
+    cycles = int(fin.state.cycle)
+    _, prof = step.run_cycles_profile(cfg, sysm.state, cycles)
+
+    # miss classes per node: (cold, conflict, coherence-inv, upgrade)
+    mn = np.asarray(prof["miss_node"])
+    np.testing.assert_array_equal(mn, [[2, 1, 0, 1], [1, 0, 1, 0]])
+    # the same classes land on the address plane, at A and B only
+    ma = np.asarray(prof["miss_addr"])
+    assert ma[A_ADDR].tolist() == [2, 1, 1, 1]
+    assert ma[B_ADDR].tolist() == [1, 0, 0, 0]
+    assert int(ma.sum()) == int(mn.sum())
+
+    # exactly one invalidation, at A, with fan-out 1 (bucket [1,2))
+    inv = np.asarray(prof["inv_addr"])
+    assert int(inv.sum()) == 1 and int(inv[A_ADDR]) == 1
+    fan = np.asarray(prof["inv_fanout"])
+    assert int(fan[1]) == 1 and int(fan.sum()) == 1
+    # 3 dirty writebacks (eviction flush + two reads of an M line);
+    # node 0 is the only writer, so no ownership migration
+    assert int(np.asarray(prof["wb_addr"]).sum()) == 3
+    assert int(np.asarray(prof["mig_addr"]).sum()) == 0
+
+    # profile totals reconcile with the engine's own metrics
+    m = fin.metrics
+    misses = int(np.sum(m["read_misses"])) + int(np.sum(m["write_misses"]))
+    assert int(mn[:, :3].sum()) == misses == 5
+    assert int(mn[:, 3].sum()) == int(np.sum(m["upgrades"])) == 1
+    assert int(inv.sum()) == int(np.sum(m["invalidations"]))
+    rd, wr = np.asarray(prof["rd"]), np.asarray(prof["wr"])
+    assert int(rd.sum() + wr.sum()) == int(np.sum(m["instrs_retired"]))
+
+
+def test_hand_trace_doc_and_classifier():
+    cfg = SystemConfig.reference(num_nodes=2)
+    sysm = _hand_system(cfg)
+    cycles = int(sysm.run(400).state.cycle)
+    doc = cohprof.capture_async(cfg, sysm.state, cycles)
+    assert doc["miss_classes"] == {
+        "cold": 3, "conflict_eviction": 1,
+        "coherence_invalidation": 1, "upgrade": 1}
+    assert doc["invalidations"]["applied"] == 1
+    assert doc["invalidations"]["fanout_hist"]["counts"][1] == 1
+    assert doc["writebacks"] == 3 and doc["ownership_migrations"] == 0
+    # A: node 0 reads+writes, node 1 reads -> migratory RMW sharing;
+    # B: node 0 only -> private.  (Untouched lines stay -1.)
+    pat = cohprof.classify(np.zeros((2, 32)), np.zeros((2, 32)))
+    assert pat.shape == (32,) and (pat == -1).all()
+    top = doc["top_contended"]
+    assert top[0]["addr"] == A_ADDR
+    assert top[0]["pattern"] == "migratory"
+    assert top[0]["writers"] == 1 and top[0]["readers"] == 2
+    assert doc["sharing"]["by_pattern"]["private"]["lines"] == 1
+    # byte-determinism of the emitted doc
+    doc2 = cohprof.capture_async(cfg, sysm.state, cycles)
+    assert json.dumps(doc, sort_keys=True) == \
+        json.dumps(doc2, sort_keys=True)
+
+
+def _assert_states_equal(plain, prof_st, tag):
+    import jax
+    a = jax.tree_util.tree_leaves_with_path(plain)
+    b = jax.tree_util.tree_leaves(prof_st)
+    assert len(a) == len(b)
+    for (path, la), lb in zip(a, b):
+        np.testing.assert_array_equal(
+            np.asarray(la), np.asarray(lb),
+            err_msg=f"{tag}{jax.tree_util.keystr(path)}")
+
+
+def test_profile_plane_off_bit_parity_async():
+    """run_cycles_profile must walk the exact trajectory of
+    run_cycles — the profile plane reads, never steers."""
+    cfg = SystemConfig.scale(8)
+    sysm = CoherenceSystem.from_workload(cfg, "false_sharing_vars",
+                                         trace_len=32, seed=7)
+    plain = step.run_cycles(cfg, sysm.state, 64)
+    prof_st, _ = step.run_cycles_profile(cfg, sysm.state, 64)
+    _assert_states_equal(plain, prof_st, "async")
+
+
+def test_profile_plane_off_bit_parity_sync_and_deep():
+    from ue22cs343bb1_openmp_assignment_tpu.ops import (deep_engine,
+                                                        sync_engine)
+    base = SystemConfig.scale(8, drain_depth=13, txn_width=3)
+    deep_cfg = dataclasses.replace(
+        base, deep_window=True, deep_slots=3, deep_ownerval_slots=1,
+        deep_horizon_slack=4, deep_waves=1, deep_read_storm=False,
+        deep_exact_flags=True)
+    for cfg, runner in ((base, sync_engine.run_sync_profile),
+                        (deep_cfg, deep_engine.run_deep_profile)):
+        ts = TransactionalSystem.from_workload(
+            cfg, "false_sharing_vars", trace_len=32, workload_seed=7)
+        plain = sync_engine.run_rounds(cfg, ts.state, 24)
+        prof_st = runner(cfg, ts.state, 24)[0]
+        _assert_states_equal(plain, prof_st,
+                             "deep" if cfg.deep_window else "sync")
+
+
+def _valid_doc():
+    cfg = SystemConfig.reference(num_nodes=2)
+    sysm = _hand_system(cfg)
+    cycles = int(sysm.run(400).state.cycle)
+    return cohprof.capture_async(cfg, sysm.state, cycles)
+
+
+def test_schema_validate_reject_matrix():
+    doc = _valid_doc()
+    cohprof.validate(doc)                       # the positive control
+
+    def reject(mutate, msg_part):
+        bad = copy.deepcopy(doc)
+        mutate(bad)
+        with pytest.raises(ValueError, match=msg_part):
+            cohprof.validate(bad)
+
+    reject(lambda d: d.update(schema="cache-sim/profile/v0"), "schema")
+    reject(lambda d: d.pop("sharing"), "missing key")
+    reject(lambda d: d.update(bogus=1), "unknown key")
+    reject(lambda d: d.update(engine="turbo"), "engine")
+    reject(lambda d: d.update(steps=-1), "steps")
+    reject(lambda d: d["accesses"].update(reads=-2), "accesses")
+    reject(lambda d: d["miss_classes"].pop("cold"), "miss_classes")
+    reject(lambda d: d["miss_classes"].update(upgrade=True),
+           "miss_classes")
+    reject(lambda d: d["invalidations"]["fanout_hist"]["bucket_lo"]
+           .reverse(), "fanout_hist")
+    reject(lambda d: d["sharing"].update(dominant="gregarious"),
+           "dominant")
+    reject(lambda d: d["sharing"]["by_pattern"].pop("private"),
+           "by_pattern")
+    reject(lambda d: d["top_contended"][0].pop("score"),
+           "top_contended")
+    reject(lambda d: d.update(extra=None), "extra")
+    # abort-anatomy arm (deep docs)
+    deep = copy.deepcopy(doc)
+    deep["abort_anatomy"] = {
+        "rounds": 4, "retired": 10,
+        "aborts": {k: 0 for k in cohprof.ABORT_CLASSES},
+        "window_stops": {k: 0 for k in cohprof.STOP_CLASSES},
+        "poison_flags": {"raised": 0, "committed": 0,
+                         "ghost_fraction": None},
+        "aborts_per_node_round": {k: 0.0
+                                  for k in cohprof.ABORT_CLASSES}}
+    cohprof.validate(deep)
+    bad = copy.deepcopy(deep)
+    bad["abort_anatomy"]["poison_flags"]["ghost_fraction"] = 0.5
+    with pytest.raises(ValueError, match="ghost_fraction"):
+        cohprof.validate(bad)                   # raised=0 forbids it
+    bad = copy.deepcopy(deep)
+    bad["abort_anatomy"]["aborts"]["poison_ghost"] = -1
+    with pytest.raises(ValueError, match="aborts"):
+        cohprof.validate(bad)
+
+
+def test_daemon_stats_profile_validates_when_present():
+    base = {
+        "schema": schema.DAEMON_STATS_SCHEMA_ID, "clock": "virtual",
+        "uptime_s": 1.0, "draining": False,
+        "jobs": {"submitted": 1, "rejected": 0, "done": 1,
+                 "quiesced": 1},
+        "lanes": {"interactive": {"weight": 1, "depth": 4, "queued": 0,
+                                  "submitted": 1, "admitted": 1,
+                                  "rejected": 0, "done": 1,
+                                  "latency": None}},
+        "buckets": [], "chunks": 0, "busy_s": 0.0,
+        "drain_rate_jobs_per_s": None, "mb_dropped": 0,
+        "mid_wave_swaps": 0, "bucket_growths": 0,
+        "queue_depth_peak": 0, "retain_results": 64,
+        "results_evicted": 0, "recording": None,
+        "padding_waste": None, "single_shape_padding_waste": None,
+    }
+    schema.validate_daemon_stats(dict(base))
+    ok = dict(base, profile=_valid_doc())
+    schema.validate_daemon_stats(ok)            # validate-when-present
+    bad = dict(base, profile={"schema": "nope"})
+    with pytest.raises(ValueError, match="profile"):
+        schema.validate_daemon_stats(bad)
+
+
+WL_EXPECT = {
+    # the workload fingerprint matrix (ISSUE 20 satellite): every
+    # builtin generator pinned to its dominant sharing pattern at
+    # scale(16)/trace_len 32/seed 0.  false_sharing (all nodes
+    # read+write node 0's two blocks) is TRUE migratory sharing;
+    # false_sharing_vars is the block-vs-variable-granularity shape
+    # the classifier exists to catch; _padded is its fix, and must
+    # classify private — the padding proven observable.
+    "uniform": "private",
+    "false_sharing": "migratory",
+    "false_sharing_vars": "false_sharing",
+    "false_sharing_vars_padded": "private",
+    "producer_consumer": "producer_consumer",
+    "hotspot": "private",
+    "zipf_hotspot": "migratory",
+}
+
+
+@pytest.mark.parametrize("wl,expect", sorted(WL_EXPECT.items()))
+def test_workload_fingerprints(wl, expect):
+    cfg = SystemConfig.scale(16)
+    sysm = CoherenceSystem.from_workload(cfg, wl, trace_len=32, seed=0)
+    steps = int(sysm.run(20000).metrics["cycles"])
+    doc = cohprof.capture_async(cfg, sysm.state, steps)
+    assert doc["sharing"]["dominant"] == expect, doc["sharing"]
+
+
+def test_flight_incident_embeds_profile(tmp_path):
+    from ue22cs343bb1_openmp_assignment_tpu.obs import flight
+    cfg = SystemConfig.reference(num_nodes=2)
+    rec = flight.FlightRecorder(cfg, _hand_system(cfg).state, k=16,
+                                chunk=8)
+    rec.run(200)
+    doc = rec.dump_incident(str(tmp_path / "inc"), "test:profile")
+    assert doc["profile"] is not None
+    cohprof.validate(doc["profile"])
+    assert doc["profile"]["steps"] == doc["cycles_run"]
+    assert doc["profile"]["miss_classes"]["cold"] == 3
+    # round-trip: load_incident re-validates the embedded profile
+    loaded = flight.load_incident(str(tmp_path / "inc"))
+    assert loaded["profile"] == doc["profile"]
+    bad = dict(loaded, profile={"schema": "nope"})
+    with open(tmp_path / "inc" / "incident.json", "w") as f:
+        json.dump(bad, f)
+    with pytest.raises(ValueError):
+        flight.load_incident(str(tmp_path / "inc"))
+
+
+def run_cli(args, tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    rc = cli.main(args)
+    out, err = capsys.readouterr()
+    return rc, out, err
+
+
+def test_cli_profile_smoke(tmp_path, monkeypatch, capsys):
+    rc, out, _ = run_cli(
+        ["profile", "mini", "--tests-root", FIXTURES, "--cpu"],
+        tmp_path, monkeypatch, capsys)
+    assert rc == 0
+    assert "coherence profile [async]" in out
+    rc, out, _ = run_cli(
+        ["profile", "--workload", "false_sharing_vars", "--nodes", "8",
+         "--trace-len", "32", "--cpu", "--json"],
+        tmp_path, monkeypatch, capsys)
+    assert rc == 0
+    doc = cohprof.validate(json.loads(out))
+    assert doc["sharing"]["dominant"] == "false_sharing"
+    rc, out, _ = run_cli(
+        ["profile", "--workload", "uniform", "--nodes", "4",
+         "--trace-len", "8", "--engine", "sync", "--cpu", "--json"],
+        tmp_path, monkeypatch, capsys)
+    assert rc == 0
+    doc = cohprof.validate(json.loads(out))
+    assert doc["engine"] == "sync" and doc["miss_classes"] is None
+    # error paths
+    rc, _, err = run_cli(["profile", "--cpu"],
+                         tmp_path, monkeypatch, capsys)
+    assert rc == 2 and "workload" in err
+    rc, _, err = run_cli(
+        ["profile", "--workload", "uniform", "--no-exact-flags",
+         "--cpu"], tmp_path, monkeypatch, capsys)
+    assert rc == 2 and "deep" in err
+
+
+def test_cli_profile_deep_smoke(tmp_path, monkeypatch, capsys):
+    rc, out, _ = run_cli(
+        ["profile", "--workload", "false_sharing", "--nodes", "8",
+         "--trace-len", "16", "--engine", "deep", "--cpu", "--json"],
+        tmp_path, monkeypatch, capsys)
+    assert rc == 0
+    doc = cohprof.validate(json.loads(out))
+    assert doc["engine"] == "deep"
+    ab = doc["abort_anatomy"]
+    assert ab is not None and ab["retired"] > 0
+    assert set(ab["aborts"]) == set(cohprof.ABORT_CLASSES)
+
+
+def _ghost_cfg(num_nodes, exact):
+    cfg = SystemConfig.scale(num_nodes, drain_depth=13, txn_width=3)
+    return dataclasses.replace(
+        cfg, proc_local_permille=800, deep_window=True, deep_slots=6,
+        deep_ownerval_slots=3, deep_horizon_slack=8, deep_waves=1,
+        deep_read_storm=False, deep_exact_flags=exact,
+        procedural="uniform", max_instrs=1)
+
+
+def test_deep_ghost_poison_fraction_window():
+    """The measured replacement for PERF.md round-4's hand estimate
+    ('roughly 2/3 of poison flags are GHOSTS'): at the anatomy config
+    shrunk to N=64, the attempt-based flag pass must raise poison on
+    entries whose attempts never commit at a fraction inside the
+    pinned window.  Measured 0.6470 (N=64), 0.6614 (N=256, the PERF.md
+    config — see the slow tier)."""
+    from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+    cfg = _ghost_cfg(64, exact=False)
+    st = se.run_rounds(cfg, se.procedural_state(cfg, 256, seed=0), 12)
+    doc = cohprof.capture_deep(cfg, st, 6)
+    pf = doc["abort_anatomy"]["poison_flags"]
+    assert pf["raised"] > 1000, pf
+    assert 0.55 <= pf["ghost_fraction"] <= 0.72, pf
+
+
+@pytest.mark.slow
+def test_deep_ghost_poison_exact_flags_reduction():
+    """At the PERF.md anatomy config (N=256 W=16 Q=6 slack=8
+    local=0.8): attempt-based flags sit in the measured 2/3-ghost
+    window, and cfg.deep_exact_flags cuts ghost-poison ABORTS by >2x
+    (measured 0.267 -> 0.065 per node per round)."""
+    from ue22cs343bb1_openmp_assignment_tpu.ops import sync_engine as se
+
+    def anatomy(exact):
+        cfg = _ghost_cfg(256, exact)
+        st = se.run_rounds(cfg, se.procedural_state(cfg, 2048, seed=0),
+                           40)
+        return cohprof.capture_deep(cfg, st, 8)["abort_anatomy"]
+
+    loose, sharp = anatomy(False), anatomy(True)
+    assert 0.60 <= loose["poison_flags"]["ghost_fraction"] <= 0.72
+    ratio = (loose["aborts_per_node_round"]["poison_ghost"]
+             / max(sharp["aborts_per_node_round"]["poison_ghost"],
+                   1e-9))
+    assert ratio > 2.0, (loose, sharp)

@@ -59,8 +59,13 @@ EQN_BUDGET = 2048
 #: masked chunk scan — measured ~1.5k flattened eqns, comfortably
 #: under the shared 2048 ceiling, so it rides the default; the entry
 #: here is the explicit first-class pin PR 15 left implicit.
+#: The profiler scan (PR 20) is the plain run_cycles body plus the
+#: per-line counter scatter-adds — measured 1434 flattened eqns at the
+#: N=4 probe config; 1664 leaves room for mask arithmetic churn while
+#: tripping if the profile plane ever grows a second pass over state.
 EQN_BUDGETS = {"pallas_round.round_body": 65536,
-               "step.run_wave_chunk[2x4]": 2048}
+               "step.run_wave_chunk[2x4]": 2048,
+               "step.run_cycles_profile[8]": 1664}
 
 _WIDE = ("int64", "uint64", "float64")
 _HOST_PRIMS = ("infeed", "outfeed")
@@ -123,6 +128,12 @@ def _targets(cfg: SystemConfig) -> dict:
         # computes
         "step.run_cycles_ledger[8]":
             lambda s: step.run_cycles_ledger(cfg, s, 8, None, True),
+        # the coherence-profiler capture path (PR 20): the per-line
+        # counter planes must fold into the scan as scatter-adds of
+        # masks the cycle already computes — budgeted so profiling
+        # never silently grows into a second engine
+        "step.run_cycles_profile[8]":
+            lambda s: step.run_cycles_profile(cfg, s, 8),
         "step.run_to_quiescence":
             lambda s: step.run_to_quiescence(cfg, s, 64),
         # the daemon's hot body (PR 15): one masked chunk of batched

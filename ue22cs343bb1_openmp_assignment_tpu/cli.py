@@ -625,6 +625,9 @@ def main(argv=None) -> int:
     if raw[:1] == ["perf-report"]:
         from ue22cs343bb1_openmp_assignment_tpu.obs import cli as obs_cli
         return obs_cli.main_perfreport(raw[1:])
+    if raw[:1] == ["profile"]:
+        from ue22cs343bb1_openmp_assignment_tpu.obs import cli as obs_cli
+        return obs_cli.main_profile(raw[1:])
     if raw[:1] == ["dashboard"]:
         from ue22cs343bb1_openmp_assignment_tpu.obs import cli as obs_cli
         return obs_cli.main_dashboard(raw[1:])

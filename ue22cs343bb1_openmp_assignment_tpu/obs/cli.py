@@ -21,6 +21,13 @@ with flow arrows linking each transaction's request/reply slices.
 prints the critical path to quiescence with per-node / per-phase cycle
 attribution. Both are async-engine surfaces (the ledger is a
 message-plane capture) and deterministic for a fixed config.
+
+``profile`` replays a run under the coherence profiler (obs.cohprof):
+per-line miss taxonomy, invalidation fan-out, sharing-pattern
+classification and the top contended lines, emitted as a validated
+``cache-sim/profile/v1`` doc (or the one-screen text rendering). All
+three engines; the deep engine additionally reports the measured abort
+anatomy incl. the ghost-poison fraction.
 """
 # lint: host
 
@@ -31,7 +38,8 @@ import json
 import os
 import sys
 
-WORKLOADS = ["uniform", "producer_consumer", "false_sharing", "fft",
+WORKLOADS = ["uniform", "producer_consumer", "false_sharing",
+             "false_sharing_vars", "false_sharing_vars_padded", "fft",
              "radix", "hotspot", "zipf_hotspot", "lu"]
 
 
@@ -773,6 +781,12 @@ def build_perfreport_parser() -> argparse.ArgumentParser:
                         "rdma lane exchange, parallel.rdma_comm."
                         "wire_bytes). Default: the attached device "
                         "count when >1, else 8; must divide --nodes")
+    p.add_argument("--profile", action="store_true",
+                   help="attach the coherence-profile block "
+                        "(obs.cohprof): replay the pinned run under "
+                        "the profiler and report sharing patterns, "
+                        "contended lines, and (deep) the measured "
+                        "abort anatomy next to the bytes they cost")
     p.add_argument("--json", action="store_true",
                    help="emit the full cache-sim/perfreport/v1 doc")
     p.add_argument("--out", metavar="PATH",
@@ -950,6 +964,24 @@ def cmd_perfreport(args) -> int:
         doc["index"] = indexcheck.index_row(args.engine, args.nodes)
         doc["index"]["indices_per_instr"] = round(
             doc["index"]["indices_per_step"] * steps / retired, 3)
+    if args.profile:
+        # the protocol-behavior sibling of the kernel table: same
+        # pinned (steps, retired) run, replayed under the coherence
+        # profiler (obs.cohprof) — which lines move the bytes, and on
+        # the deep engine which aborts burn the rounds
+        from ue22cs343bb1_openmp_assignment_tpu.obs import cohprof
+        if args.engine == "async":
+            doc["profile"] = cohprof.capture_async(cfg, st0, steps)
+        elif args.engine == "deep":
+            space = args.nodes * (args.nodes << cfg.block_bits)
+            if space * 4 > 1 << 29:
+                print("note: deep profile plane too large at this "
+                      "--nodes; omitting the profile block",
+                      file=sys.stderr)
+            else:
+                doc["profile"] = cohprof.capture_deep(cfg, st0, steps)
+        else:
+            doc["profile"] = cohprof.capture_sync(cfg, st0, steps)
     if args.timing:
         timer = PhaseTimer()
         rep_times = []
@@ -969,6 +1001,9 @@ def cmd_perfreport(args) -> int:
         _emit(args, doc)
     else:
         text = roofline.render_text(doc)
+        if "profile" in doc:
+            from ue22cs343bb1_openmp_assignment_tpu.obs import cohprof
+            text += "\n" + cohprof.render_text(doc["profile"]) + "\n"
         if args.out:
             with open(args.out, "w") as f:
                 f.write(text)
@@ -1023,6 +1058,12 @@ def build_dashboard_parser() -> argparse.ArgumentParser:
                         "--record artifact or record dir); repeatable; "
                         "renders as the captured-traffic table, each "
                         "row replayable with cache-sim replay")
+    p.add_argument("--profile", metavar="PATH", action="append",
+                   default=[],
+                   help="a cache-sim/profile/v1 doc (cache-sim "
+                        "profile --json); repeatable; renders as the "
+                        "coherence-profile table (dominant sharing "
+                        "pattern, miss mix, ghost-poison fraction)")
     return p
 
 
@@ -1071,12 +1112,19 @@ def cmd_dashboard(args) -> int:
             from ue22cs343bb1_openmp_assignment_tpu.obs import (
                 recording)
             recordings = [recording.load(p) for p in args.recording]
+        profiles = []
+        for path in args.profile:
+            from ue22cs343bb1_openmp_assignment_tpu.obs import cohprof
+            with open(path) as f:
+                prof = cohprof.validate(json.load(f))
+            prof["extra"].setdefault("path", path)
+            profiles.append(prof)
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     res = dashboard.render(entries, html_path=args.html,
                            md_path=args.md, litmus=litmus,
-                           recordings=recordings)
+                           recordings=recordings, profiles=profiles)
     if args.json:
         print(json.dumps(res["model"], sort_keys=True))
     for path in (args.html, args.md):
@@ -1106,3 +1154,127 @@ def main_trace(argv) -> int:
         import jax
         jax.config.update("jax_platforms", "cpu")
     return cmd_trace(args)
+
+
+# lint: host
+def build_profile_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cache-sim profile",
+        description="replay a run under the coherence profiler "
+                    "(obs.cohprof) and emit per-line contention "
+                    "attribution: miss taxonomy (cold / conflict / "
+                    "coherence-invalidation / upgrade), invalidation "
+                    "fan-out, sharing-pattern classification, top "
+                    "contended lines — and for --engine deep the "
+                    "measured abort anatomy (ghost-poison fraction). "
+                    "Deterministic: same config, same doc bytes.")
+    _add_common(p)
+    p.add_argument("--engine", choices=["async", "sync", "deep"],
+                   default="async",
+                   help="async = full counter plane (misses / inv / "
+                        "writebacks / migrations); sync = access "
+                        "planes + classifier; deep = access planes + "
+                        "abort anatomy")
+    p.add_argument("--top", type=int, default=8,
+                   help="contended lines to attribute (default 8)")
+    p.add_argument("--no-exact-flags", action="store_true",
+                   help="deep engine: profile the conservative "
+                        "flag-raising path (cfg.deep_exact_flags off) "
+                        "— the configuration whose ghost-poison "
+                        "fraction PERF.md estimates")
+    p.add_argument("--json", action="store_true",
+                   help="emit the cache-sim/profile/v1 doc instead of "
+                        "the text rendering")
+    p.add_argument("--out", metavar="PATH",
+                   help="write the output here instead of stdout")
+    return p
+
+
+# lint: host
+def cmd_profile(args) -> int:
+    import dataclasses
+
+    from ue22cs343bb1_openmp_assignment_tpu.obs import cohprof
+
+    if args.no_exact_flags and args.engine != "deep":
+        print("error: --no-exact-flags is a deep-engine knob; "
+              "add --engine deep", file=sys.stderr)
+        return 2
+    if args.engine == "async":
+        system0 = _async_system(args)
+        if system0 is None:
+            print("error: provide <test_directory> or --workload",
+                  file=sys.stderr)
+            return 2
+        # two-pass replay discipline (--timeseries/--txns do the
+        # same): the plain run pins the cycle count, the profiled
+        # replay from the initial state walks the identical trajectory
+        if args.run_cycles is not None:
+            steps = args.run_cycles
+        else:
+            steps = int(system0.run(args.max_cycles).metrics["cycles"])
+        doc = cohprof.capture_async(system0.cfg, system0.state, steps,
+                                    k=args.top)
+    else:
+        from ue22cs343bb1_openmp_assignment_tpu.config import (
+            SystemConfig)
+        from ue22cs343bb1_openmp_assignment_tpu.models.transactional \
+            import TransactionalSystem
+        deep = args.engine == "deep"
+        cfg = SystemConfig.scale(
+            num_nodes=args.nodes,
+            drain_depth=13 if deep else 4, txn_width=3)
+        if deep:
+            # mirror perf-report's measured-best deep defaults so the
+            # anatomy describes the same program the headline measures
+            cfg = dataclasses.replace(
+                cfg, deep_window=True,
+                deep_slots=2 if args.nodes >= 32768 else 3,
+                deep_ownerval_slots=1, deep_horizon_slack=4,
+                deep_waves=1, deep_read_storm=False,
+                deep_exact_flags=not args.no_exact_flags)
+            space = args.nodes * (args.nodes << cfg.block_bits)
+            if space * 4 > 1 << 29:
+                print("error: deep profile plane would need "
+                      f"{space * 4 >> 20} MiB (nodes x addr-space "
+                      "counters); profile the deep engine at a "
+                      "smaller --nodes", file=sys.stderr)
+                return 2
+        if args.workload:
+            ts = TransactionalSystem.from_workload(
+                cfg, args.workload, trace_len=args.trace_len,
+                workload_seed=args.seed)
+        elif args.test_dir:
+            path = os.path.join(args.tests_root, args.test_dir)
+            ts = TransactionalSystem.from_test_dir(path)
+            cfg = ts.cfg
+        else:
+            print("error: provide <test_directory> or --workload",
+                  file=sys.stderr)
+            return 2
+        if args.run_cycles is not None:
+            steps = args.run_cycles
+        else:
+            steps = int(ts.run(max_rounds=args.max_cycles)
+                        .state.metrics.rounds)
+        cap = cohprof.capture_deep if deep else cohprof.capture_sync
+        doc = cap(cfg, ts.state, steps, k=args.top)
+    if args.json:
+        _emit(args, doc)
+    else:
+        text = cohprof.render_text(doc) + "\n"
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text)
+        else:
+            sys.stdout.write(text)
+    return 0
+
+
+# lint: host
+def main_profile(argv) -> int:
+    args = build_profile_parser().parse_args(argv)
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    return cmd_profile(args)

@@ -94,10 +94,40 @@ def _recording_rows(recordings: Optional[list]) -> list:
 
 
 # lint: host
+def _profile_rows(profiles: Optional[list]) -> list:
+    """Normalize ``cache-sim/profile/v1`` docs (obs.cohprof) into the
+    coherence-profile table rows."""
+    rows = []
+    for doc in profiles or []:
+        mc = doc.get("miss_classes")
+        ab = doc.get("abort_anatomy")
+        rows.append({
+            "label": (doc.get("extra") or {}).get("path")
+            or f"{doc['engine']}@{doc['nodes']}",
+            "engine": doc["engine"],
+            "nodes": doc["nodes"],
+            "steps": doc["steps"],
+            "dominant": doc["sharing"]["dominant"],
+            "classified_lines": doc["sharing"]["classified_lines"],
+            "misses": None if mc is None else sum(mc.values()),
+            "coherence_misses": None if mc is None
+            else mc["coherence_invalidation"],
+            "invalidations": (doc["invalidations"] or {}).get("applied")
+            if doc.get("invalidations") is not None else None,
+            "ghost_fraction": None if ab is None
+            else ab["poison_flags"]["ghost_fraction"],
+            "top_addr": (doc["top_contended"][0]["addr"]
+                         if doc["top_contended"] else None),
+        })
+    return rows
+
+
+# lint: host
 def build_model(entries: List[dict],
                 target: float = TARGET_INSTRS_PER_S,
                 litmus: Optional[dict] = None,
-                recordings: Optional[list] = None) -> dict:
+                recordings: Optional[list] = None,
+                profiles: Optional[list] = None) -> dict:
     """Reduce a loaded history to the renderable model.
 
     Splits entries into the instrs/sec headline series, the multichip
@@ -178,6 +208,7 @@ def build_model(entries: List[dict],
             "serving": serving, "latency": latency,
             "litmus": _litmus_cells(litmus),
             "recordings": _recording_rows(recordings),
+            "profiles": _profile_rows(profiles),
             "n_entries": len(entries)}
 
 
@@ -385,6 +416,31 @@ def _recordings_html(rows: list) -> str:
 
 
 # lint: host
+def _profiles_html(rows: list) -> str:
+    if not rows:
+        return ("<p><em>no profiles loaded (capture with cache-sim "
+                "profile --json --out p.json, then dashboard "
+                "--profile p.json)</em></p>")
+    trs = []
+    for r in rows:
+        miss = "—" if r["misses"] is None else (
+            f"{r['misses']} ({r['coherence_misses']} coh)")
+        inv = ("—" if r["invalidations"] is None
+               else f"{r['invalidations']}")
+        gf = ("—" if r["ghost_fraction"] is None
+              else f"{r['ghost_fraction']:.1%}")
+        trs.append(f"<tr><td>{r['label']}</td><td>{r['engine']}</td>"
+                   f"<td>{r['nodes']}</td><td>{r['steps']}</td>"
+                   f"<td>{r['dominant'] or '—'} "
+                   f"({r['classified_lines']} lines)</td>"
+                   f"<td>{miss}</td><td>{inv}</td><td>{gf}</td></tr>")
+    return ("<table><tr><th>profile</th><th>engine</th><th>nodes</th>"
+            "<th>steps</th><th>dominant sharing</th><th>misses</th>"
+            "<th>invalidations</th><th>ghost poison</th></tr>"
+            + "".join(trs) + "</table>")
+
+
+# lint: host
 def render_html(model: dict) -> str:
     """The self-contained static HTML report."""
     rows = []
@@ -426,6 +482,8 @@ td, th {{ border: 1px solid #d5dbdb; padding: 4px 10px;
 {_svg_series("latency", model["latency"], "value", None, "ms p95")}
 <h2>Recordings (captured traffic)</h2>
 {_recordings_html(model["recordings"])}
+<h2>Coherence profiles (sharing &amp; abort anatomy)</h2>
+{_profiles_html(model["profiles"])}
 <h2>bench-diff verdicts (adjacent pairs)</h2>
 {verdict_html}
 <h2>Coverage: protocol &times; workload</h2>
@@ -510,6 +568,29 @@ def render_markdown(model: dict) -> str:
         lines.append("*no recordings loaded (capture with cache-sim "
                      "daemon --record DIR, then dashboard "
                      "--recording DIR)*")
+    lines += ["", "## Coherence profiles (sharing & abort anatomy)",
+              ""]
+    if model["profiles"]:
+        lines += ["| profile | engine | nodes | steps "
+                  "| dominant sharing | misses | invalidations "
+                  "| ghost poison |",
+                  "|---|---|---:|---:|---|---:|---:|---:|"]
+        for r in model["profiles"]:
+            miss = "—" if r["misses"] is None else (
+                f"{r['misses']} ({r['coherence_misses']} coh)")
+            inv = ("—" if r["invalidations"] is None
+                   else f"{r['invalidations']}")
+            gf = ("—" if r["ghost_fraction"] is None
+                  else f"{r['ghost_fraction']:.1%}")
+            lines.append(
+                f"| {r['label']} | {r['engine']} | {r['nodes']} "
+                f"| {r['steps']} | {r['dominant'] or '—'} "
+                f"({r['classified_lines']} lines) | {miss} "
+                f"| {inv} | {gf} |")
+    else:
+        lines.append("*no profiles loaded (capture with cache-sim "
+                     "profile --json --out p.json, then dashboard "
+                     "--profile p.json)*")
     lines += ["", "## bench-diff verdicts (adjacent pairs)", ""]
     if model["verdicts"]:
         lines += ["| pair | verdict | delta |", "|---|---|---:|"]
@@ -565,10 +646,12 @@ def render_markdown(model: dict) -> str:
 def render(entries: List[dict], html_path: Optional[str] = None,
            md_path: Optional[str] = None,
            litmus: Optional[dict] = None,
-           recordings: Optional[list] = None) -> dict:
+           recordings: Optional[list] = None,
+           profiles: Optional[list] = None) -> dict:
     """Build the model and write the requested artifacts; returns
     ``{"model", "html_path", "md_path"}``."""
-    model = build_model(entries, litmus=litmus, recordings=recordings)
+    model = build_model(entries, litmus=litmus, recordings=recordings,
+                        profiles=profiles)
     if html_path:
         with open(html_path, "w") as f:
             f.write(render_html(model))

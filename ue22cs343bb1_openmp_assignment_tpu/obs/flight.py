@@ -14,7 +14,10 @@ by hand. The flight recorder turns each of those into a self-contained
   ``txn_summary`` from the causal tracer (obs.txntrace): the slowest
   five transactions of the incident's tail with their latency
   decomposition and every transaction still in flight when the
-  recorder stopped;
+  recorder stopped, and a ``profile`` block — the validated
+  ``cache-sim/profile/v1`` coherence profile (obs.cohprof) of the
+  replayed run: which lines were contended, how they were missing,
+  and what sharing pattern they exhibit;
 - ``trace.perfetto.json`` — a validated Perfetto event trace of the
   run replayed from the initial state (the engine is deterministic, so
   the replay IS the incident);
@@ -168,9 +171,17 @@ class FlightRecorder:
         # everything still in flight when the recorder stopped — the
         # hang suspects, by name
         txn_summary = None
+        profile = None
         if self.cycles_run:
-            from ue22cs343bb1_openmp_assignment_tpu.obs import txntrace
+            from ue22cs343bb1_openmp_assignment_tpu.obs import (cohprof,
+                                                                txntrace)
             txn_summary = txntrace.incident_summary(
+                self.cfg, self.state0, self.cycles_run,
+                self.message_phase)
+            # same deterministic-replay discipline: the coherence
+            # profile of the exact run that tripped the incident —
+            # which lines were contended and how they were missing
+            profile = cohprof.capture_async(
                 self.cfg, self.state0, self.cycles_run,
                 self.message_phase)
         doc = {
@@ -186,6 +197,7 @@ class FlightRecorder:
                              if ring else None),
             "metrics": self._metrics_doc(),
             "txn_summary": txn_summary,
+            "profile": profile,
             "trace_cycles": n_trace,
             "has_repro": case is not None,
             "files": sorted(files),
@@ -237,6 +249,10 @@ def load_incident(incident_dir: str) -> dict:
     for k in ("reason", "cycles_run", "metrics", "files"):
         if k not in doc:
             raise ValueError(f"{path}: missing key {k!r}")
+    if doc.get("profile") is not None:
+        # validate-when-present: pre-profiler incidents stay loadable
+        from ue22cs343bb1_openmp_assignment_tpu.obs import cohprof
+        cohprof.validate(doc["profile"])
     return doc
 
 

@@ -559,6 +559,15 @@ def validate_daemon_stats(doc: dict) -> dict:
                         or v < 0):
                     errs.append(f"events.{k} must be a non-negative "
                                 f"int, got {v!r}")
+    prof = doc.get("profile")
+    if prof is not None:
+        # validate-when-present: a coherence-profile doc (obs.cohprof)
+        # attached by a daemon running with profiling on
+        from ue22cs343bb1_openmp_assignment_tpu.obs import cohprof
+        try:
+            cohprof.validate(prof)
+        except ValueError as e:
+            errs.append(f"profile: {e}")
     if errs:
         raise ValueError("invalid daemon stats:\n  " + "\n  ".join(errs))
     return doc

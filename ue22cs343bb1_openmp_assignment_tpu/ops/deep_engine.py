@@ -146,6 +146,8 @@ re-touches across waves and the window proceeds).
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -156,7 +158,8 @@ from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState, Op
 from ue22cs343bb1_openmp_assignment_tpu.ops import deep_fold
 from ue22cs343bb1_openmp_assignment_tpu.ops.sync_engine import (
     DM_ACT, DM_CLAIM, DM_COLS, DM_COUNT, DM_MEM, DM_OWNER, DM_REQ,
-    DM_STATE, SyncState, _round_key_rs, claim_max_rounds, slot_bits)
+    DM_STATE, SyncState, _assert_round_budget, _pack_outside,
+    _round_key_rs, claim_max_rounds, slot_bits)
 
 # slot kinds (remote events): fill requests and eviction notices
 K_NONE, K_RD, K_WR, K_UP, K_EVS, K_EVM, K_PROBE = 0, 1, 2, 3, 4, 5, 6
@@ -173,6 +176,22 @@ F_MARK, F_POISON = 1, 2
 ACT_NONE, ACT_DOWN, ACT_KILL, ACT_PROMOTE = 0, 1, 2, 3
 
 _INT_MAX = jnp.iinfo(jnp.int32).max
+
+#: column order of the per-address / per-node abort-attribution planes
+#: (round_step_deep(return_profile=True) / run_deep_profile): a slot
+#: that failed to commit did so because a poison flag aborted it — a
+#: GHOST flag (the committed replay never confirmed the home touch
+#:   that raised it) or a REAL one — or a mark aborted its eviction
+#: notice, or it lost its arbitration lane, or its cache-hit probe was
+#: unsafe. obs/cohprof.py turns these into the measured abort anatomy
+#: (the ghost fraction PERF.md previously hand-estimated at ~2/3).
+PROFILE_ABORT_CLASSES = ("poison_ghost", "poison_real", "mark",
+                         "lane_loss", "probe")
+
+#: column order of the per-node window-stop counters in the same plane
+#: (the replay fold's s_* reasons: slot-budget overflow, ownerval-slot
+#: overflow, same-entry re-touch, cross-slot dependency, liveness cap)
+PROFILE_STOP_CLASSES = ("over_q", "over_g", "dup", "dep", "live")
 
 
 def state_tiles(cfg: SystemConfig, st: SyncState):
@@ -288,7 +307,8 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
                     with_events: bool = False,
                     return_stats: bool = False,
                     fold_impl: str = "xla",
-                    index_ops=None):
+                    index_ops=None,
+                    return_profile: bool = False):
     """One deep-window round. See module docstring for the design.
 
     ``fold_impl`` selects how the two W-step folds execute: ``"xla"``
@@ -311,13 +331,28 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
     ``return_stats=True`` instead returns ``(state, stats)`` with the
     round's anatomy as scalar sums (attempted/committed slots by kind,
     lane losses, priority aborts, truncated/stopped node counts) — the
-    measurement surface behind scripts/prof_deepstats.py."""
+    measurement surface behind scripts/prof_deepstats.py.
+
+    ``return_profile=True`` instead returns ``(state, prof_delta)``:
+    the round's coherence-profiler contribution as ADDITIVE planes
+    (per-(node, address) retired accesses, the per-address /
+    per-node abort attribution split poison-ghost / poison-real /
+    mark / lane-loss / probe, window-stop reasons, and the raised-vs-
+    committed poison-flag pair behind the measured ghost fraction) —
+    run_deep_profile sums them across rounds; obs/cohprof.py reduces
+    the total into the ``cache-sim/profile/v1`` doc. XLA fold only,
+    like return_stats."""
     if with_events and return_stats:
         raise ValueError("with_events and return_stats are mutually "
                          "exclusive (one round returns one extra value)")
-    if return_stats and fold_impl != "xla":
-        raise ValueError("return_stats needs the XLA fold (the Pallas "
-                         "kernels do not export the anatomy fields)")
+    if return_profile and (with_events or return_stats):
+        raise ValueError("return_profile is exclusive with with_events/"
+                         "return_stats (one round returns one extra "
+                         "value)")
+    if (return_stats or return_profile) and fold_impl != "xla":
+        raise ValueError("return_stats/return_profile need the XLA fold "
+                         "(the Pallas kernels do not export the anatomy "
+                         "fields)")
     N, C, S = cfg.num_nodes, cfg.cache_size, 1 << cfg.block_bits
     E = N * S
     W = cfg.drain_depth + cfg.txn_width
@@ -371,7 +406,7 @@ def round_step_deep(cfg: SystemConfig, st: SyncState,
                            index_ops if index_ops is not None
                            else XlaIndexOps())
     return _finish_round_deep(cfg, st, core, w_oa, w_val, with_events,
-                              return_stats)
+                              return_stats, return_profile)
 
 
 def deep_round_core(cfg: SystemConfig, dm0, round_, seed, pre,
@@ -1042,12 +1077,18 @@ def deep_round_core(cfg: SystemConfig, dm0, round_, seed, pre,
         kind=kind, is_req=is_req, is_ev=is_ev, won_any=won_any,
         aborting=aborting, probe_bad=probe_bad,
         commit_acc=commit_acc, rel_acc=rel_acc,
-        clean_self=clean_self, storm_committed=storm_committed)
+        clean_self=clean_self, storm_committed=storm_committed,
+        # profile-tail extras (return_profile, XLA fold only — the
+        # fused-kernel core dict, ops/pallas_round, omits them like the
+        # other anatomy fields): slot entry ids, the abort-driving
+        # poison source flags, and the poison-side abort mask
+        ent=ent, poison_src=poison_src, req_abort=req_abort)
 
 
 def _finish_round_deep(cfg: SystemConfig, st: SyncState, core,
                        w_oa, w_val, with_events: bool,
-                       return_stats: bool):
+                       return_stats: bool,
+                       return_profile: bool = False):
     """Fold a deep_round_core result back into the SyncState: metrics
     from the per-node delta rows, window-cursor/horizon advance, and
     the optional stats/events extras. Shared by the XLA reference path
@@ -1101,6 +1142,54 @@ def _finish_round_deep(cfg: SystemConfig, st: SyncState, core,
             stop_dup=s_(rp["s_dup"]), stop_dep=s_(rp["s_dep"]),
             stop_live=s_(rp["s_live"]))
         return out, stats
+    if return_profile:
+        # additive profiler deltas (run_deep_profile sums them): the
+        # abort attribution distinguishes poison flags the committed
+        # replay confirmed (rp["poison"], retirement-gated) from ghosts
+        # the speculative flag source raised beyond the committed
+        # prefix — the measured form of PERF.md's ghost estimate
+        N, S = cfg.num_nodes, 1 << cfg.block_bits
+        E = N * S
+        rows = jnp.arange(N, dtype=jnp.int32)
+        is_req, is_ev = core["is_req"], core["is_ev"]
+        real_arr = core["rp"]["poison"].T.reshape(E)          # [E] bool
+        ent = jnp.clip(core["ent"], 0, E - 1)                 # [Q, N]
+        flag_real = real_arr[ent]
+        lost = (is_req | is_ev) & ~core["won_any"] & ~core["aborting"] \
+            & ~core["storm_committed"]
+        classes = jnp.stack([                                 # [Q, N, 5]
+            core["req_abort"] & ~flag_real,
+            core["req_abort"] & flag_real,
+            core["aborting"] & is_ev,
+            lost,
+            core["probe_bad"]], axis=-1).astype(jnp.int32)
+        any_ab = jnp.sum(classes, axis=-1) > 0
+        abort_addr = jnp.zeros((E, 5), jnp.int32).at[
+            jnp.where(any_ab, ent, E)].add(classes, mode="drop")
+        # retired-access planes from the committed window prefix
+        offs = jnp.arange(W, dtype=jnp.int32)[:, None]        # [W, 1]
+        ret = offs < rp["n_ret"][None, :]                     # [W, N]
+        opw = w_oa >> 28
+        addrw = jnp.clip(w_oa & 0x0FFFFFFF, 0, E - 1)
+        flat = rows[None, :] * E + addrw                      # [W, N]
+        rd = jnp.zeros((N * E,), jnp.int32).at[
+            jnp.where(ret & (opw == int(Op.READ)), flat, N * E)].add(
+            1, mode="drop").reshape(N, E)
+        wr = jnp.zeros((N * E,), jnp.int32).at[
+            jnp.where(ret & (opw == int(Op.WRITE)), flat, N * E)].add(
+            1, mode="drop").reshape(N, E)
+        i32 = jnp.int32
+        prof = dict(
+            rd=rd, wr=wr,
+            abort_addr=abort_addr,                            # [E, 5]
+            abort_node=jnp.sum(classes, axis=0),              # [N, 5]
+            stops=jnp.stack(                                  # [5, N]
+                [rp["s_overq"], rp["s_overg"], rp["s_dup"],
+                 rp["s_dep"], rp["s_live"]]).astype(i32),
+            poison_raised=jnp.sum(core["poison_src"], dtype=i32),
+            poison_committed=jnp.sum(rp["poison"], dtype=i32),
+            n_ret=rp["n_ret"].astype(i32))                    # [N]
+        return out, prof
     if not with_events:
         return out
     offs_w = jnp.arange(W, dtype=jnp.int32)[:, None]
@@ -1108,3 +1197,49 @@ def _finish_round_deep(cfg: SystemConfig, st: SyncState, core,
               "op": w_oa.T >> 28, "addr": w_oa.T & 0x0FFFFFFF,
               "value": w_val.T}
     return out, events
+
+
+def deep_profile_zeros(cfg: SystemConfig):
+    """Zero-initialised accumulator matching round_step_deep's
+    return_profile delta dict (see _finish_round_deep) — the scan carry
+    of run_deep_profile."""
+    N, S = cfg.num_nodes, 1 << cfg.block_bits
+    E = N * S
+    z = functools.partial(jnp.zeros, dtype=jnp.int32)
+    return dict(rd=z((N, E)), wr=z((N, E)),
+                abort_addr=z((E, 5)), abort_node=z((N, 5)),
+                stops=z((5, N)),
+                poison_raised=z(()), poison_committed=z(()),
+                n_ret=z((N,)))
+
+
+def run_deep_profile(cfg: SystemConfig, st: SyncState, n: int):
+    """Scan n deep rounds accumulating the coherence-profiler planes.
+
+    Returns ``(state, prof)`` with ``prof`` a deep_profile_zeros dict
+    after summation: retired per-(node, address) accesses, the
+    PROFILE_ABORT_CLASSES per-address/per-node abort attribution, the
+    PROFILE_STOP_CLASSES window-stop counters, and the raised-vs-
+    committed poison-flag totals whose ratio is the measured
+    ghost-poison fraction (obs/cohprof.py). XLA fold only (the
+    return_profile contract); the accumulation rides the scan carry,
+    so capture cost is independent of n.
+    """
+    _assert_round_budget(cfg, st.round, n)
+    return _run_deep_profile_jit(cfg, st, n)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def _run_deep_profile_jit(cfg: SystemConfig, st: SyncState, n: int):
+    carry0, pack = _pack_outside(st)
+    prof0 = deep_profile_zeros(cfg)
+
+    def body(carry, _):
+        s, p = carry
+        out, d = round_step_deep(cfg, s.replace(instr_pack=pack),
+                                 return_profile=True)
+        p2 = jax.tree.map(lambda a, b: a + b, p, d)
+        return (out.replace(instr_pack=carry0.instr_pack), p2), None
+
+    (final, prof), _ = jax.lax.scan(body, (carry0, prof0), None, length=n)
+    return final.replace(instr_pack=pack), prof

@@ -33,7 +33,8 @@ from ue22cs343bb1_openmp_assignment_tpu import codec
 from ue22cs343bb1_openmp_assignment_tpu.config import SystemConfig
 from ue22cs343bb1_openmp_assignment_tpu.ops import frontend, handlers, mailbox
 from ue22cs343bb1_openmp_assignment_tpu.state import LAT_BUCKETS, SimState
-from ue22cs343bb1_openmp_assignment_tpu.types import CacheState, DirState, Msg
+from ue22cs343bb1_openmp_assignment_tpu.types import (CacheState, DirState,
+                                                      Msg, Op)
 
 #: names of the per-cycle counter-delta vector emitted in telemetry
 #: mode (cycle(with_telemetry=True) / run_cycles_telemetry), in order —
@@ -59,6 +60,65 @@ LEDGER_FIELDS = ("deq_has", "deq_sender", "deq_type", "deq_addr",
                  "fetch", "issue", "op", "addr", "value", "unblocked")
 LEDGER_OBS_FIELDS = ("obs_retire", "obs_val")
 
+#: miss-taxonomy column order of the profile plane's ``miss_node`` /
+#: ``miss_addr`` counters (cycle(with_profile=True) / run_cycles_profile)
+#: — Hill & Smith's 3C classes with the conflict/capacity pair collapsed
+#: (the sim's direct-mapped cache makes every non-cold tag eviction a
+#: conflict) and extended with the two coherence classes a directory
+#: protocol adds: a miss whose tag still matches but whose line an INV
+#: killed, and a write hit on a SHARED line (upgrade — no data motion,
+#: pure permission miss)
+PROFILE_MISS_CLASSES = ("cold", "conflict_eviction",
+                        "coherence_invalidation", "upgrade")
+
+#: power-of-two buckets of the invalidation fan-out histogram (like
+#: state.LAT_BUCKETS): bucket 0 = fan-out exactly 0 is never recorded
+#: (a broadcast with no victims emits nothing); bucket b >= 1 = fan-out
+#: in [2^(b-1), 2^b), so bucket 1 is single-victim, bucket 2 is 2-3
+#: victims, ... — wide enough for a full-broadcast at 2^14 nodes
+FANOUT_BUCKETS = 16
+
+
+def profile_space(cfg: SystemConfig) -> int:
+    """Size of the profile plane's address axis: the global address
+    space ``N << block_bits`` (codec.make_address packs (node, block)
+    into that range). Per-address planes index by raw address."""
+    return cfg.num_nodes << cfg.block_bits
+
+
+def profile_zeros(cfg: SystemConfig):
+    """Zero-initialised profile-counter carry for run_cycles_profile.
+
+    All planes accumulate across the scan (unlike the stacked
+    per-cycle telemetry samples) so the capture cost is O(planes), not
+    O(cycles x planes):
+
+      rd / wr      [N, A]  per-(node, address) read / write accesses,
+                           attributed at fetch
+      ever         [N, A]  node has ever fetched address (cold-miss
+                           classifier input)
+      miss_node    [N, 4]  per-node miss counts, PROFILE_MISS_CLASSES
+      miss_addr    [A, 4]  per-address miss counts, same columns
+      inv_addr     [A]     invalidations attributed to the address
+      inv_fanout   [16]    fan-out histogram, FANOUT_BUCKETS buckets
+      wb_addr      [A]     dirty writebacks arriving at the home
+      last_writer  [A]     last retiring writer node (-1 = none yet)
+      mig_addr     [A]     ownership migrations (retired write by a
+                           different node than the previous writer)
+    """
+    N = cfg.num_nodes
+    A = profile_space(cfg)
+    z = functools.partial(jnp.zeros, dtype=jnp.int32)
+    return {
+        "rd": z((N, A)), "wr": z((N, A)),
+        "ever": jnp.zeros((N, A), bool),
+        "miss_node": z((N, 4)), "miss_addr": z((A, 4)),
+        "inv_addr": z((A,)), "inv_fanout": z((FANOUT_BUCKETS,)),
+        "wb_addr": z((A,)),
+        "last_writer": jnp.full((A,), -1, jnp.int32),
+        "mig_addr": z((A,)),
+    }
+
 #: commit-path seam for the index-pressure auditor's seeded mutation
 #: (analysis/mutations.INDEX_MUTATIONS.split_packed_scatter). True =
 #: the shipped packed row commit (one scatter per state family, the
@@ -74,7 +134,8 @@ _PACKED_COMMIT = True
 def cycle(cfg: SystemConfig, state: SimState,
           with_events: bool = False, message_phase=None,
           with_telemetry: bool = False, with_ledger: bool = False,
-          with_obs: bool = False, deliver_fn=None):
+          with_obs: bool = False, deliver_fn=None,
+          with_profile: bool = False, prof=None):
     """Advance the whole machine by one cycle.
 
     Cross-sender arbitration order for this cycle's deliveries comes from
@@ -115,6 +176,18 @@ def cycle(cfg: SystemConfig, state: SimState,
     The sharded transports (parallel/rdma_comm.make_routed_deliver)
     use this to route enqueue candidates across shards before a
     shard-local enqueue; single-device callers leave it None.
+
+    ``with_profile=True`` threads the coherence-profiler counter plane
+    (``prof``, a profile_zeros dict) through the cycle and appends the
+    updated dict LAST in the output tuple: per-(node, address) access
+    counts, the PROFILE_MISS_CLASSES miss taxonomy (classified against
+    the PRE-commit cache tags plus the cumulative ``ever`` plane),
+    invalidation fan-out, home-side dirty writebacks and
+    ownership-migration counts. Everything lives in this `if` arm, so
+    the default path's trace — and therefore its compiled HLO — is
+    bit-identical with the plane off (tests/test_cohprof.py pins
+    final-state parity; `bench-diff --bytes` pins the HLO cost
+    vector).
     """
     if message_phase is None:
         message_phase = handlers.message_phase
@@ -311,6 +384,7 @@ def cycle(cfg: SystemConfig, state: SimState,
     # lookup: did my home broadcast my tag this cycle, with my bit set?
     # O(N*C) gathers — no cross-node product.
     inv_applied = jnp.zeros((), jnp.int32)
+    kill_live = None                  # [N, C] live lines the INV killed
     if inv_scatter is not None:
         im, ia, ibv = inv_scatter                       # [N], [N], [N, W]
         h = jnp.clip(codec.home_node(cfg, cache_addr), 0, N - 1)  # [N, C]
@@ -319,8 +393,8 @@ def cycle(cfg: SystemConfig, state: SimState,
         tb = (rows % 32).astype(jnp.uint32)[:, None]
         word = ibv[h, tw]                               # [N, C] u32
         kill = active & (((word >> tb) & 1) == 1)
-        inv_applied = jnp.sum(
-            kill & (cache_state != int(CacheState.INVALID))).astype(jnp.int32)
+        kill_live = kill & (cache_state != int(CacheState.INVALID))
+        inv_applied = jnp.sum(kill_live).astype(jnp.int32)
         cache_state = jnp.where(kill, int(CacheState.INVALID), cache_state)
 
     # ---- metrics ---------------------------------------------------------
@@ -368,6 +442,118 @@ def cycle(cfg: SystemConfig, state: SimState,
         mb_depth_peak=jnp.maximum(mt.mb_depth_peak, depth_peak),
     )
 
+    # ---- profile plane (coherence profiler, obs/cohprof.py) --------------
+    # accumulating counters, not per-cycle samples: every plane below is
+    # added into the carried `prof` dict, so the scan output is O(planes)
+    new_prof = None
+    if with_profile:
+        A = profile_space(cfg)
+        issued = f_stats["issued"]
+        rh, wh = f_stats["read_hits"], f_stats["write_hits"]
+        rm, wm = f_stats["read_misses"], f_stats["write_misses"]
+        upg = f_stats["upgrades"]
+        miss = rm | wm
+        addr_f = jnp.clip(l_addr, 0, A - 1)
+        # miss taxonomy against the PRE-commit tags: tag matches but the
+        # line is INVALID -> a coherence invalidation killed it; no
+        # matching tag and this node never fetched the address -> cold;
+        # otherwise a conflict eviction displaced it. Upgrades (write
+        # hit on SHARED) are the pure permission-miss column.
+        ci_f = jnp.clip(codec.cache_index(cfg, l_addr), 0, C - 1)
+        tag_f = state.cache_addr[rows, ci_f]
+        st_f = state.cache_state[rows, ci_f]
+        coh = miss & (tag_f == l_addr) & (st_f == int(CacheState.INVALID))
+        seen = prof["ever"][rows, addr_f]
+        cold = miss & ~coh & ~seen
+        conf = miss & ~coh & seen
+        classes = jnp.stack([cold, conf, coh, upg],
+                            axis=1).astype(jnp.int32)            # [N, 4]
+        any_cls = cold | conf | coh | upg
+        rd = prof["rd"].at[rows, jnp.where(rh | rm, addr_f, A)].add(
+            1, mode="drop")
+        wr = prof["wr"].at[rows, jnp.where(wh | wm, addr_f, A)].add(
+            1, mode="drop")
+        ever = prof["ever"].at[rows, jnp.where(issued, addr_f, A)].set(
+            True, mode="drop")
+        miss_node = prof["miss_node"] + classes
+        miss_addr = prof["miss_addr"].at[
+            jnp.where(any_cls, addr_f, A)].add(classes, mode="drop")
+
+        bins = jnp.arange(FANOUT_BUCKETS, dtype=jnp.int32)
+
+        def fan_hist(fan):
+            # power-of-two bucket per broadcasting home; fan == 0 (no
+            # victims / no broadcast) records nothing
+            fb = jnp.clip(32 - jax.lax.clz(jnp.maximum(fan, 1)),
+                          1, FANOUT_BUCKETS - 1)
+            oh = (bins[:, None] == fb[None, :]) & (fan > 0)[None, :]
+            return jnp.sum(oh.astype(jnp.int32), axis=1)
+
+        inv_addr_p, inv_fan = prof["inv_addr"], prof["inv_fanout"]
+        if kill_live is not None:
+            # scatter mode: victims and fan-out both come from the dense
+            # kill plane of this same cycle, so sum(inv_addr) tracks the
+            # inv_applied metric exactly
+            tags = jnp.clip(cache_addr, 0, A - 1)
+            inv_addr_p = inv_addr_p.at[
+                jnp.where(kill_live, tags, A)].add(1, mode="drop")
+            fan = jnp.zeros((N,), jnp.int32).at[h].add(
+                kill_live.astype(jnp.int32), mode="drop")
+            inv_fan = inv_fan + fan_hist(fan)
+        else:
+            # mailbox mode: victims counted where the INV dequeues and
+            # its tag still matches (the same mask the invalidations
+            # metric sums); fan-out counted send-side at the home that
+            # emitted the broadcast slots this cycle
+            ci_m = jnp.clip(codec.cache_index(cfg, mv.addr), 0, C - 1)
+            deq_inv = (mv.has_msg & (mv.type == int(Msg.INV))
+                       & (state.cache_addr[rows, ci_m] == mv.addr))
+            inv_addr_p = inv_addr_p.at[
+                jnp.where(deq_inv, jnp.clip(mv.addr, 0, A - 1), A)].add(
+                1, mode="drop")
+            sent = m_cand["inv"][0] == int(Msg.INV)              # [N, N]
+            inv_fan = inv_fan + fan_hist(
+                jnp.sum(sent.astype(jnp.int32), axis=1))
+
+        # dirty writebacks, counted once at the home's dequeue (FLUSH /
+        # FLUSH_INVACK also reach the requester as the fill reply; the
+        # home copy is the memory write)
+        wb = (mv.has_msg
+              & ((mv.type == int(Msg.FLUSH))
+                 | (mv.type == int(Msg.FLUSH_INVACK))
+                 | (mv.type == int(Msg.EVICT_MODIFIED)))
+              & (codec.home_node(cfg, mv.addr) == rows))
+        wb_addr = prof["wb_addr"].at[
+            jnp.where(wb, jnp.clip(mv.addr, 0, A - 1), A)].add(
+            1, mode="drop")
+
+        # ownership migration: a WRITE retires (immediately on an M/E
+        # hit, or at unblock for misses/upgrades — the two are exclusive
+        # per node, drain-before-fetch) on an address whose previous
+        # retiring writer was a different node
+        w_ret = (wh & ~upg) | (unblocked & (state.cur_op == int(Op.WRITE)))
+        w_a = jnp.clip(jnp.where(fetch, l_addr, state.cur_addr), 0, A - 1)
+        prev = prof["last_writer"][w_a]
+        mig = w_ret & (prev >= 0) & (prev != rows)
+        mig_addr = prof["mig_addr"].at[
+            jnp.where(mig, w_a, A)].add(1, mode="drop")
+        # same-address write collisions in one cycle cannot happen (one
+        # owner in M/E; one unblock per fill), but keep the update
+        # deterministic anyway: lowest node id wins via scatter-min
+        sent_max = jnp.iinfo(jnp.int32).max
+        cand_w = jnp.full((A,), sent_max, jnp.int32).at[
+            jnp.where(w_ret, w_a, A)].min(rows, mode="drop")
+        last_writer = jnp.where(cand_w != sent_max, cand_w,
+                                prof["last_writer"])
+
+        new_prof = {
+            "rd": rd, "wr": wr, "ever": ever,
+            "miss_node": miss_node, "miss_addr": miss_addr,
+            "inv_addr": inv_addr_p, "inv_fanout": inv_fan,
+            "wb_addr": wb_addr,
+            "last_writer": last_writer, "mig_addr": mig_addr,
+        }
+
     new_state = state.replace(
         cache_addr=cache_addr, cache_val=cache_val, cache_state=cache_state,
         memory=memory, dir_state=dir_state, dir_bitvec=dir_bitvec,
@@ -375,7 +561,7 @@ def cycle(cfg: SystemConfig, state: SimState,
         cur_op=cur_op, cur_addr=cur_addr, cur_val=cur_val, waiting=waiting,
         waiting_since=waiting_since,
         cycle=state.cycle + 1, metrics=metrics, **mb_upd)
-    if not with_events and not with_telemetry and not with_ledger:
+    if not (with_events or with_telemetry or with_ledger or with_profile):
         return new_state
     out = (new_state,)
     if with_events:
@@ -463,6 +649,8 @@ def cycle(cfg: SystemConfig, state: SimState,
                 cache_val[rows, codec.cache_index(cfg, cur_addr)],
                 -1).astype(jnp.int16)
         out = out + (ledger,)
+    if with_profile:
+        out = out + (new_prof,)
     return out
 
 
@@ -595,6 +783,34 @@ def run_cycles(cfg: SystemConfig, state: SimState,
 
     final, _ = jax.lax.scan(body, carry0, None, length=num_cycles)
     return final.replace(**ro)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2, 3))
+def run_cycles_profile(cfg: SystemConfig, state: SimState,
+                       num_cycles: int, message_phase=None):
+    """Scan `num_cycles` cycles accumulating the coherence-profile plane.
+
+    Returns ``(state, prof)`` with ``prof`` the profile_zeros dict
+    after accumulation (see cycle's with_profile contract). Unlike the
+    telemetry/ledger runners the capture rides the scan CARRY, not the
+    stacked output, so the transfer cost is independent of run length —
+    obs/cohprof.py reduces the planes host-side into the
+    ``cache-sim/profile/v1`` doc. ``message_phase`` is `cycle`'s static
+    handler-phase override (the flight recorder profiles mutant runs
+    with it).
+    """
+    carry0, ro, blanks = _ro_outside(state)
+    prof0 = profile_zeros(cfg)
+
+    def body(carry, _):
+        s, p = carry
+        out, p2 = cycle(cfg, s.replace(**ro), message_phase=message_phase,
+                        with_profile=True, prof=p)
+        return (out.replace(**blanks), p2), None
+
+    (final, prof), _ = jax.lax.scan(body, (carry0, prof0), None,
+                                    length=num_cycles)
+    return final.replace(**ro), prof
 
 
 def _run_quiescence(cfg: SystemConfig, state: SimState, chunk: int,

@@ -1371,3 +1371,48 @@ def _run_sync_jit(cfg: SystemConfig, st: SyncState, chunk: int,
 
     final = jax.lax.while_loop(cond, chunk_body, carry0)
     return final.replace(instr_pack=pack)
+
+
+def run_sync_profile(cfg: SystemConfig, st: SyncState, n: int):
+    """Scan n rounds accumulating per-(node, address) retired-access
+    planes for the coherence profiler (obs/cohprof.py).
+
+    Returns ``(state, rd, wr)`` with rd/wr [N, N << block_bits] int32:
+    retired READ/WRITE accesses folded from the per-round retirement
+    record — the sync engine's analogue of the async with_profile
+    access planes (miss taxonomy and invalidation attribution are
+    async/deep-only; the sharing classifier needs only these). The
+    accumulation rides the scan carry, so capture cost is independent
+    of n. Works for any round_step dispatch that supports with_events
+    (deep rounds use ops.deep_engine.run_deep_profile instead, which
+    adds the abort-attribution planes).
+    """
+    _assert_round_budget(cfg, st.round, n)
+    return _run_sync_profile_jit(cfg, st, n)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def _run_sync_profile_jit(cfg: SystemConfig, st: SyncState, n: int):
+    N = cfg.num_nodes
+    A = N << cfg.block_bits
+    carry0, pack = _pack_outside(st)
+    rows = jnp.arange(N, dtype=jnp.int32)
+    z = jnp.zeros((N * A,), jnp.int32)
+
+    def body(carry, _):
+        s, rd, wr = carry
+        out, ev = round_step(cfg, s.replace(instr_pack=pack),
+                             with_events=True)
+        ret = ev["retired"]                                   # [N, W]
+        addr = jnp.clip(ev["addr"], 0, A - 1)
+        flat = rows[:, None] * A + addr                       # [N, W]
+        rd = rd.at[jnp.where(ret & (ev["op"] == int(Op.READ)),
+                             flat, N * A)].add(1, mode="drop")
+        wr = wr.at[jnp.where(ret & (ev["op"] == int(Op.WRITE)),
+                             flat, N * A)].add(1, mode="drop")
+        return (out.replace(instr_pack=carry0.instr_pack), rd, wr), None
+
+    (final, rd, wr), _ = jax.lax.scan(body, (carry0, z, z), None,
+                                      length=n)
+    return (final.replace(instr_pack=pack),
+            rd.reshape(N, A), wr.reshape(N, A))
